@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""North-star benchmark: Word2Vec skip-gram words/sec/chip.
+
+BASELINE.json: "Word2Vec words/sec/chip (text8, 1M vocab, dim=200)" on real
+TPU, target >=10x an 8-node CPU parameter-server baseline. The reference
+published no numbers (BASELINE.md), so the baseline is calibrated here: a
+vectorized numpy SGNS worker loop (the reference's per-worker compute, C++-ish
+throughput via BLAS) measured on this host, scaled by the reference's Hadoop
+deployment width (8 worker reducers, hadoop-worker.sh mapred.reduce.tasks=8).
+
+Zero-egress environment: text8 is synthesized as a zipf-distributed token
+stream with the same vocab size/shape; words/sec counts corpus tokens
+consumed, derived from measured pairs/sec via the sampler's pairs-per-token
+ratio (identical accounting for TPU and baseline).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+# -- workload shape (north-star config) --------------------------------------
+VOCAB = 1_000_000
+DIM = 200
+WINDOW = 5
+NEGATIVES = 5
+BATCH = 16_384
+MEASURE_STEPS = 60
+WARMUP_STEPS = 3
+BASELINE_NODES = 8  # reference deployment width (hadoop-worker.sh)
+
+
+def synth_corpus(n_tokens: int, vocab: int, seed: int = 0) -> np.ndarray:
+    """Zipf-ish token stream over [0, vocab) — text8-shaped frequencies."""
+    rng = np.random.default_rng(seed)
+    # zipf via inverse-CDF over harmonic weights (s=1.05, bounded support)
+    ranks = np.arange(1, vocab + 1, dtype=np.float64)
+    w = 1.0 / ranks**1.05
+    cdf = np.cumsum(w) / w.sum()
+    u = rng.random(n_tokens)
+    return np.searchsorted(cdf, u).astype(np.int32)
+
+
+def measure_tpu(counts: np.ndarray, batches, pairs_per_token: float) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    cfg = Config(
+        {
+            "dim": str(DIM),
+            "window": str(WINDOW),
+            "negatives": str(NEGATIVES),
+            "learning_rate": "0.025",
+            "batch_size": str(BATCH),
+            "subsample": "0",
+            "num_iters": "1",
+        }
+    )
+    vocab = Vocab([f"w{i}" for i in range(VOCAB)], counts)
+    trainer = Word2VecTrainer(
+        cfg, mesh=None, corpus_ids=np.zeros(2, np.int32), vocab=vocab
+    )
+    state = trainer.init_state()
+    step = jax.jit(trainer.train_step, donate_argnums=(0,))
+    rng = jax.random.PRNGKey(0)
+    dev_batches = [
+        {k: jnp.asarray(v) for k, v in b.items()} for b in batches
+    ]
+    for i in range(WARMUP_STEPS):
+        state, m = step(state, dev_batches[i % len(dev_batches)], jax.random.fold_in(rng, i))
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, m = step(state, dev_batches[i % len(dev_batches)], jax.random.fold_in(rng, i))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    pairs_per_sec = MEASURE_STEPS * BATCH / dt
+    return pairs_per_sec / pairs_per_token
+
+
+def measure_cpu_baseline(batches, pairs_per_token: float, emb_dim=DIM) -> float:
+    """Calibrated per-node CPU PS worker: vectorized numpy SGNS minibatch SGD."""
+    rng = np.random.default_rng(0)
+    syn0 = (rng.random((VOCAB, emb_dim), dtype=np.float32) - 0.5) / emb_dim
+    syn1 = np.zeros((VOCAB, emb_dim), dtype=np.float32)
+    lr = np.float32(0.025)
+
+    def sigmoid(x):
+        return 1.0 / (1.0 + np.exp(-x))
+
+    n_meas = 4
+    t0 = time.perf_counter()
+    for i in range(n_meas):
+        b = batches[i % len(batches)]
+        centers, contexts = b["centers"], b["contexts"]
+        negs = rng.integers(0, VOCAB, size=(len(centers), NEGATIVES)).astype(np.int32)
+        v = syn0[centers]  # [B, D] pull
+        u_pos = syn1[contexts]
+        u_neg = syn1[negs.reshape(-1)].reshape(len(centers), NEGATIVES, emb_dim)
+        g_pos = sigmoid(np.einsum("bd,bd->b", v, u_pos)) - 1.0  # [B]
+        g_neg = sigmoid(np.einsum("bd,bkd->bk", v, u_neg))  # [B, K]
+        dv = g_pos[:, None] * u_pos + np.einsum("bk,bkd->bd", g_neg, u_neg)
+        du_pos = g_pos[:, None] * v
+        du_neg = g_neg[..., None] * v[:, None, :]
+        np.add.at(syn0, centers, -lr * dv)  # push (scatter-add, dup-safe)
+        np.add.at(syn1, contexts, -lr * du_pos)
+        np.add.at(syn1, negs.reshape(-1), -lr * du_neg.reshape(-1, emb_dim))
+    dt = time.perf_counter() - t0
+    pairs_per_sec = n_meas * BATCH / dt
+    return pairs_per_sec / pairs_per_token
+
+
+def main():
+    from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
+
+    rng = np.random.default_rng(1)
+    n_tokens = 600_000
+    ids = synth_corpus(n_tokens, VOCAB)
+    counts = np.bincount(ids, minlength=VOCAB).astype(np.int64)
+    counts = np.maximum(counts, 1)
+    centers, contexts = skipgram_pairs(ids, WINDOW, rng)
+    pairs_per_token = len(centers) / n_tokens
+    batches = list(batch_stream(centers, contexts, BATCH, rng))[:24]
+
+    words_per_sec = measure_tpu(counts, batches, pairs_per_token)
+    node_wps = measure_cpu_baseline(batches, pairs_per_token)
+    baseline_wps = BASELINE_NODES * node_wps
+
+    print(
+        json.dumps(
+            {
+                "metric": "word2vec_words_per_sec_per_chip",
+                "value": round(words_per_sec, 1),
+                "unit": "words/sec/chip",
+                "vs_baseline": round(words_per_sec / baseline_wps, 3),
+                "baseline_words_per_sec_8node_cpu": round(baseline_wps, 1),
+                "pairs_per_token": round(pairs_per_token, 3),
+                "config": {
+                    "vocab": VOCAB,
+                    "dim": DIM,
+                    "window": WINDOW,
+                    "negatives": NEGATIVES,
+                    "batch": BATCH,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+from swiftsnails_tpu.data.vocab import Vocab
+from swiftsnails_tpu.data.text import read_tokens, encode_corpus
+from swiftsnails_tpu.data.sampler import (
+    AliasTable,
+    build_unigram_alias,
+    skipgram_pairs,
+    subsample_mask,
+)
+
+__all__ = [
+    "Vocab",
+    "read_tokens",
+    "encode_corpus",
+    "AliasTable",
+    "build_unigram_alias",
+    "skipgram_pairs",
+    "subsample_mask",
+]

@@ -1,0 +1,129 @@
+"""Skip-gram pair generation and negative sampling.
+
+Implements the word2vec training-data pipeline the reference shipped as an
+(absent) app over ``BaseAlgorithm`` (survey §2.7): dynamic-window skip-gram
+pairs, frequent-word subsampling, and unigram^0.75 negative sampling.
+
+Negative sampling runs **on device** via the alias method: two O(vocab)
+arrays built once on the host, O(1) sampling per draw inside the jit'd step —
+no host RNG in the hot loop (the original word2vec.c uses a 100M-entry
+resampling table; the alias table is the exact-distribution equivalent).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class AliasTable(NamedTuple):
+    """Walker alias table for a discrete distribution over [0, n)."""
+
+    prob: jax.Array  # f32[n] — acceptance probability of the home bucket
+    alias: jax.Array  # i32[n] — fallback outcome per bucket
+
+    @property
+    def n(self) -> int:
+        return self.prob.shape[0]
+
+
+def build_alias(weights: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vose's alias construction (host, O(n))."""
+    w = np.asarray(weights, dtype=np.float64)
+    if w.ndim != 1 or len(w) == 0 or np.any(w < 0) or w.sum() == 0:
+        raise ValueError("weights must be a nonempty 1-D nonnegative array with positive sum")
+    n = len(w)
+    p = w * (n / w.sum())
+    prob = np.zeros(n, dtype=np.float32)
+    alias = np.zeros(n, dtype=np.int32)
+    small = [i for i in range(n) if p[i] < 1.0]
+    large = [i for i in range(n) if p[i] >= 1.0]
+    while small and large:
+        s = small.pop()
+        l = large.pop()
+        prob[s] = p[s]
+        alias[s] = l
+        p[l] = (p[l] + p[s]) - 1.0
+        (small if p[l] < 1.0 else large).append(l)
+    for i in large:
+        prob[i] = 1.0
+        alias[i] = i
+    for i in small:  # numerical leftovers
+        prob[i] = 1.0
+        alias[i] = i
+    return prob, alias
+
+
+def build_unigram_alias(counts: np.ndarray, power: float = 0.75) -> AliasTable:
+    """word2vec negative-sampling distribution: freq^0.75."""
+    weights = np.asarray(counts, dtype=np.float64) ** power
+    prob, alias = build_alias(weights)
+    return AliasTable(prob=jnp.asarray(prob), alias=jnp.asarray(alias))
+
+
+def alias_sample(table: AliasTable, rng: jax.Array, shape) -> jax.Array:
+    """Draw ids from the alias table on device. Jittable, O(1) per draw."""
+    k_bucket, k_coin = jax.random.split(rng)
+    bucket = jax.random.randint(k_bucket, shape, 0, table.n, dtype=jnp.int32)
+    coin = jax.random.uniform(k_coin, shape, dtype=jnp.float32)
+    keep = coin < table.prob[bucket]
+    return jnp.where(keep, bucket, table.alias[bucket])
+
+
+def subsample_mask(
+    ids: np.ndarray, counts: np.ndarray, threshold: float, rng: np.random.Generator
+) -> np.ndarray:
+    """Frequent-word subsampling (word2vec): keep word w with probability
+    ``min(1, sqrt(t/f(w)) + t/f(w))`` where f is the corpus frequency."""
+    if threshold <= 0:
+        return np.ones(len(ids), dtype=bool)
+    freqs = counts / counts.sum()
+    f = freqs[ids]
+    keep_p = np.minimum(1.0, np.sqrt(threshold / f) + threshold / f)
+    return rng.random(len(ids)) < keep_p
+
+
+def skipgram_pairs(
+    ids: np.ndarray,
+    window: int,
+    rng: np.random.Generator,
+    dynamic: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized (center, context) pair generation over an id stream.
+
+    For each position, a per-position window ``b ~ U(1, window)`` (word2vec's
+    dynamic window) selects neighbors at offsets ``-b..-1, 1..b``. Returns
+    int32 arrays (centers, contexts).
+    """
+    n = len(ids)
+    if n < 2:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    b = rng.integers(1, window + 1, size=n) if dynamic else np.full(n, window)
+    offsets = np.arange(-window, window + 1)
+    offsets = offsets[offsets != 0]  # [2w]
+    pos = np.arange(n)[:, None] + offsets[None, :]  # [n, 2w]
+    valid = (pos >= 0) & (pos < n) & (np.abs(offsets)[None, :] <= b[:, None])
+    centers = np.repeat(np.arange(n), valid.sum(axis=1))
+    contexts = pos[valid]
+    return ids[centers].astype(np.int32), ids[contexts].astype(np.int32)
+
+
+def batch_stream(
+    centers: np.ndarray,
+    contexts: np.ndarray,
+    batch_size: int,
+    rng: np.random.Generator,
+    shuffle: bool = True,
+    drop_remainder: bool = True,
+):
+    """Yield {'centers', 'contexts'} batches of exactly ``batch_size``."""
+    n = len(centers)
+    order = rng.permutation(n) if shuffle else np.arange(n)
+    end = (n // batch_size) * batch_size if drop_remainder else n
+    for start in range(0, end, batch_size):
+        sel = order[start : start + batch_size]
+        yield {"centers": centers[sel], "contexts": contexts[sel]}

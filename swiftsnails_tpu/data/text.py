@@ -1,0 +1,50 @@
+"""Corpus reading and encoding.
+
+Host-side line/whitespace tokenization — the role of the reference's
+``TextBuffer``/``LineFileReader``/``scan_file_by_line`` (``src/utils/Buffer.h:240-324``,
+``string.h``, ``file.h:11-33``). The pure-Python path here is the portable
+fallback; a C++ fast path is planned as ``swiftsnails_tpu.data.native``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from swiftsnails_tpu.data.vocab import Vocab
+
+
+def read_tokens(path: str, limit_bytes: Optional[int] = None) -> List[str]:
+    """Whitespace-tokenize a corpus file (text8-style: one giant line is fine)."""
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        data = f.read(limit_bytes) if limit_bytes else f.read()
+    return data.split()
+
+
+def encode_corpus(
+    path: str,
+    min_count: int = 5,
+    max_vocab: Optional[int] = None,
+    limit_bytes: Optional[int] = None,
+    vocab: Optional[Vocab] = None,
+) -> Tuple[np.ndarray, Vocab]:
+    """Read, build (or reuse) a vocab, and encode to an int32 id stream."""
+    tokens = read_tokens(path, limit_bytes=limit_bytes)
+    if vocab is None:
+        vocab = Vocab.build(tokens, min_count=min_count, max_size=max_vocab)
+    ids = vocab.encode(tokens)
+    return ids, vocab
+
+
+def iter_line_records(path: str, process_index: int = 0, process_count: int = 1) -> Iterator[str]:
+    """Line records, round-robin sharded by process.
+
+    Replaces the reference's Hadoop-Streaming data split (each worker's stdin
+    was its split: ``src/tools/run_worker.sh`` ``cat > ./data.txt``) with
+    deterministic sharding by process index.
+    """
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for i, line in enumerate(f):
+            if i % process_count == process_index:
+                yield line.rstrip("\n")

@@ -1,0 +1,76 @@
+"""Vocabulary: token -> id with frequency counts.
+
+The reference keeps its vocab in a host-side hashmap (``src/utils/hashmap.h``
+wrappers over google sparsehash) and its word2vec data as whitespace-separated
+int features (``src/tools/gen-word2vec-data.py``). Here the vocab is a plain
+dict built once on the host; the hot encode path is vectorized through numpy
+(and later the C++ pipeline extension).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+class Vocab:
+    """Frequency-ranked vocabulary with min-count filtering."""
+
+    def __init__(self, words: List[str], counts: np.ndarray):
+        assert len(words) == len(counts)
+        self.words = words
+        self.counts = np.asarray(counts, dtype=np.int64)
+        self.index: Dict[str, int] = {w: i for i, w in enumerate(words)}
+
+    @classmethod
+    def build(
+        cls,
+        tokens: Iterable[str],
+        min_count: int = 5,
+        max_size: Optional[int] = None,
+    ) -> "Vocab":
+        counter = collections.Counter(tokens)
+        items = [(w, c) for w, c in counter.items() if c >= min_count]
+        # rank by frequency desc, then lexicographic for determinism
+        items.sort(key=lambda wc: (-wc[1], wc[0]))
+        if max_size is not None:
+            items = items[:max_size]
+        words = [w for w, _ in items]
+        counts = np.array([c for _, c in items], dtype=np.int64)
+        return cls(words, counts)
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+    def __contains__(self, word: str) -> bool:
+        return word in self.index
+
+    def encode(self, tokens: Iterable[str]) -> np.ndarray:
+        """Token stream -> int32 ids, dropping OOV (word2vec convention)."""
+        idx = self.index
+        return np.fromiter(
+            (idx[t] for t in tokens if t in idx), dtype=np.int32
+        )
+
+    # -- persistence (text format: "word<TAB>count" per line, rank order) ----
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            for w, c in zip(self.words, self.counts):
+                f.write(f"{w}\t{int(c)}\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Vocab":
+        words: List[str] = []
+        counts: List[int] = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                w, c = line.split("\t")
+                words.append(w)
+                counts.append(int(c))
+        return cls(words, np.array(counts, dtype=np.int64))

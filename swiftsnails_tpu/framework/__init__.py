@@ -1,0 +1,3 @@
+from swiftsnails_tpu.framework.trainer import Trainer, TrainLoop
+
+__all__ = ["Trainer", "TrainLoop"]

@@ -1,0 +1,133 @@
+"""Trainer contract and the training driver loop.
+
+Capability parity with the reference's worker framework
+(``src/core/framework/SwiftWorker.h``):
+
+* ``BaseAlgorithm<Key,Val,Grad,Record>`` (``SwiftWorker.h:19-57``: virtual
+  ``train()`` / ``parse_record()``, a data path, a private thread channel)
+  -> :class:`Trainer`: subclasses provide ``init_state`` / ``batches`` /
+  ``train_step`` and the framework owns the loop;
+* ``SwiftWorker::operator()`` (``SwiftWorker.h:88-124``: cluster init, then
+  ``alg.train()``, then terminate) -> :class:`TrainLoop`: jit + donation,
+  device feed, metrics windows, periodic checkpoint hook;
+* ``local_train`` mode (``SwiftWorker.h:114-123``: skip the cluster, train
+  against the local cache) -> a ``None``/single-device mesh — the same code
+  path, just a trivial mesh.
+
+Config keys honored (reference inventory, survey §2.9): ``num_iters``,
+``learning_rate``, ``batch_size``, ``param_backup_period``,
+``param_backup_root``, ``local_train``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from swiftsnails_tpu.utils.config import Config
+from swiftsnails_tpu.utils.metrics import MetricsLogger
+from swiftsnails_tpu.parallel.mesh import DATA_AXIS, batch_sharding
+
+
+class Trainer:
+    """Pluggable training algorithm (``BaseAlgorithm`` equivalent).
+
+    Subclasses implement:
+
+    * :meth:`init_state`  — build the (sharded) model state pytree;
+    * :meth:`batches`     — yield host batches (dicts of numpy arrays, static
+      shapes; the analog of ``parse_record`` + minibatching);
+    * :meth:`train_step`  — pure jit-compatible ``(state, batch, rng) ->
+      (state, metrics)``;
+    * :meth:`items_per_batch` — unit count for throughput metrics (words,
+      examples).
+    """
+
+    name: str = "trainer"
+
+    def __init__(self, config: Config, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh
+
+    # -- subclass API ------------------------------------------------------
+
+    def init_state(self) -> Any:
+        raise NotImplementedError
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        raise NotImplementedError
+
+    def train_step(self, state: Any, batch: Dict[str, jax.Array], rng: jax.Array
+                   ) -> Tuple[Any, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def items_per_batch(self, batch: Dict[str, np.ndarray]) -> int:
+        first = next(iter(batch.values()))
+        return int(first.shape[0])
+
+    # -- optional hooks ----------------------------------------------------
+
+    def export_text(self, state: Any, path: str) -> None:
+        """Final param export (ServerTerminate parity). Optional."""
+
+    def eval_metrics(self, state: Any) -> Dict[str, float]:
+        return {}
+
+
+class TrainLoop:
+    """The driver: jit with state donation, device feed, metrics, checkpoints."""
+
+    def __init__(
+        self,
+        trainer: Trainer,
+        metrics: Optional[MetricsLogger] = None,
+        checkpoint_fn: Optional[Callable[[Any, int], None]] = None,
+        log_every: int = 100,
+    ):
+        self.trainer = trainer
+        self.metrics = metrics or MetricsLogger(echo=False)
+        self.checkpoint_fn = checkpoint_fn
+        self.log_every = log_every
+        cfg = trainer.config
+        self.backup_period = cfg.get_int("param_backup_period", 0)
+        self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
+
+    def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        mesh = self.trainer.mesh
+        if mesh is None or mesh.shape.get(DATA_AXIS, 1) == 1:
+            return {k: jnp.asarray(v) for k, v in batch.items()}
+        bs = batch_sharding(mesh)
+        return {k: jax.device_put(v, bs) for k, v in batch.items()}
+
+    def run(self, seed: int = 0, max_steps: Optional[int] = None) -> Any:
+        trainer = self.trainer
+        state = trainer.init_state()
+        root_rng = jax.random.PRNGKey(seed)
+        step = 0
+        last_metrics: Dict[str, jax.Array] = {}
+        for batch in trainer.batches():
+            n_items = trainer.items_per_batch(batch)
+            dev_batch = self._device_batch(batch)
+            rng = jax.random.fold_in(root_rng, step)
+            state, last_metrics = self._step_fn(state, dev_batch, rng)
+            step += 1
+            self.metrics.count(n_items)
+            if self.log_every and step % self.log_every == 0:
+                host = {k: float(v) for k, v in last_metrics.items()}
+                self.metrics.flush_window(step=step, **host)
+            if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
+                self.checkpoint_fn(state, step)
+            if max_steps is not None and step >= max_steps:
+                break
+        # block so throughput/final metrics are real, then final flush
+        jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        if step % max(self.log_every, 1) != 0 or not self.log_every:
+            host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
+            self.metrics.flush_window(step=step, **host)
+        return state

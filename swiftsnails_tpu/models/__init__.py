@@ -1,0 +1,3 @@
+from swiftsnails_tpu.models.word2vec import Word2VecTrainer, W2VState, sgns_loss
+
+__all__ = ["Word2VecTrainer", "W2VState", "sgns_loss"]

@@ -1,0 +1,190 @@
+"""Word2Vec skip-gram with negative sampling — the flagship trainer.
+
+The reference shipped word2vec as an app over the parameter server
+(``src/apps/word2vec``, absent from the snapshot; evidenced by
+``src/tools/copy_exec.sh`` ``APP=word2vec``, ``hadoop-server.sh`` shipping
+``word2vec.conf`` and ``src/tools/gen-word2vec-data.py``): workers pull
+embedding rows for the words in their split, compute SGNS gradients into the
+local cache, and push them back to the sharded table (survey §3.3).
+
+TPU-native version: the two embedding tables (input ``syn0`` / output
+``syn1neg``) are row-sharded :class:`~swiftsnails_tpu.parallel.store.TableState`
+arrays; one jit'd step does pull (gather) -> SGNS loss -> grads w.r.t. the
+pulled rows -> push (merge + scatter update). Negative sampling happens
+on device via an alias table. This is the BASELINE.json north-star workload
+(words/sec/chip).
+
+Config keys: ``dim``, ``window``, ``negatives``, ``learning_rate``,
+``num_iters``, ``batch_size``, ``min_count``, ``max_vocab``, ``subsample``,
+``hash_keys``, ``capacity``, ``chunk_tokens``, ``seed``, ``data``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.data.sampler import (
+    AliasTable,
+    alias_sample,
+    batch_stream,
+    build_unigram_alias,
+    skipgram_pairs,
+    subsample_mask,
+)
+from swiftsnails_tpu.data.text import encode_corpus
+from swiftsnails_tpu.data.vocab import Vocab
+from swiftsnails_tpu.ops.hashing import hash_row
+from swiftsnails_tpu.parallel.access import SgdAccess
+from swiftsnails_tpu.parallel.store import TableState, create_table, pull, push
+from swiftsnails_tpu.framework.trainer import Trainer
+from swiftsnails_tpu.utils.config import Config
+
+
+class W2VState(NamedTuple):
+    in_table: TableState  # syn0: center-word embeddings
+    out_table: TableState  # syn1neg: context/negative embeddings
+
+
+def sgns_loss(v: jax.Array, u_pos: jax.Array, u_neg: jax.Array) -> jax.Array:
+    """Skip-gram negative-sampling loss.
+
+    ``v``: [B, D] center rows; ``u_pos``: [B, D] context rows;
+    ``u_neg``: [B, K, D] negative rows. Mean over batch of
+    ``-log σ(v·u_pos) - Σ_k log σ(-v·u_neg_k)``.
+    """
+    pos = jnp.sum(v * u_pos, axis=-1)
+    neg = jnp.einsum("bd,bkd->bk", v, u_neg)
+    return -(jax.nn.log_sigmoid(pos) + jax.nn.log_sigmoid(-neg).sum(axis=-1)).mean()
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class Word2VecTrainer(Trainer):
+    name = "word2vec"
+
+    def __init__(
+        self,
+        config: Config,
+        mesh=None,
+        corpus_ids: Optional[np.ndarray] = None,
+        vocab: Optional[Vocab] = None,
+    ):
+        super().__init__(config, mesh)
+        cfg = config
+        self.dim = cfg.get_int("dim", 100)
+        self.window = cfg.get_int("window", 5)
+        self.negatives = cfg.get_int("negatives", 5)
+        self.lr = cfg.get_float("learning_rate", 0.025)
+        self.epochs = cfg.get_int("num_iters", 1)
+        self.batch_size = cfg.get_int("batch_size", 1024)
+        self.subsample = cfg.get_float("subsample", 1e-4)
+        self.hash_keys = cfg.get_bool("hash_keys", False)
+        self.chunk_tokens = cfg.get_int("chunk_tokens", 1 << 20)
+        self.seed = cfg.get_int("seed", 0)
+
+        if corpus_ids is None:
+            data_path = cfg.get_str("data")
+            corpus_ids, vocab = encode_corpus(
+                data_path,
+                min_count=cfg.get_int("min_count", 5),
+                max_vocab=cfg.get_int("max_vocab", 0) or None,
+            )
+        assert vocab is not None, "vocab required when corpus_ids is given"
+        self.corpus_ids = np.asarray(corpus_ids, dtype=np.int32)
+        self.vocab = vocab
+        cap = cfg.get_int("capacity", 0) or _next_pow2(max(len(vocab), 2))
+        self.capacity = cap
+        if not self.hash_keys and len(vocab) > cap:
+            raise ValueError(
+                f"vocab {len(vocab)} exceeds capacity {cap}; set hash_keys: 1"
+            )
+        self.access = SgdAccess()
+        self.neg_alias = build_unigram_alias(vocab.counts)
+
+    # -- state -------------------------------------------------------------
+
+    def init_state(self) -> W2VState:
+        in_table = create_table(
+            self.capacity, self.dim, self.access, mesh=self.mesh, seed=self.seed
+        )
+        # reference word2vec inits syn1neg to zeros; init_scale=0 keeps that
+        out_table = create_table(
+            self.capacity, self.dim, self.access, mesh=self.mesh,
+            seed=self.seed + 1, init_scale=0.0,
+        )
+        return W2VState(in_table=in_table, out_table=out_table)
+
+    def _rows(self, keys: jax.Array) -> jax.Array:
+        if self.hash_keys:
+            return hash_row(keys, self.capacity)
+        return keys
+
+    # -- data --------------------------------------------------------------
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.default_rng(self.seed)
+        counts = self.vocab.counts
+        for _ in range(self.epochs):
+            ids = self.corpus_ids
+            for start in range(0, len(ids), self.chunk_tokens):
+                chunk = ids[start : start + self.chunk_tokens]
+                if self.subsample > 0:
+                    chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
+                centers, contexts = skipgram_pairs(chunk, self.window, rng)
+                yield from batch_stream(centers, contexts, self.batch_size, rng)
+
+    # -- step --------------------------------------------------------------
+
+    def train_step(self, state: W2VState, batch, rng):
+        centers, contexts = batch["centers"], batch["contexts"]
+        b = centers.shape[0]
+        k = self.negatives
+        negs = alias_sample(self.neg_alias, rng, (b, k))
+        in_rows = self._rows(centers)
+        out_rows = self._rows(jnp.concatenate([contexts, negs.reshape(-1)]))
+
+        v = pull(state.in_table, in_rows)
+        u = pull(state.out_table, out_rows)
+
+        def loss_of(v, u):
+            return sgns_loss(v, u[:b], u[b:].reshape(b, k, -1))
+
+        loss, (dv, du) = jax.value_and_grad(loss_of, argnums=(0, 1))(v, u)
+        in_table = push(state.in_table, in_rows, dv, self.access, self.lr)
+        out_table = push(state.out_table, out_rows, du, self.access, self.lr)
+        return W2VState(in_table, out_table), {"loss": loss}
+
+    # -- export (ServerTerminate parity: text dump of the table) -----------
+
+    def export_text(self, state: W2VState, path: str) -> None:
+        rows = np.asarray(
+            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32)))
+        )
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"{len(self.vocab)} {self.dim}\n")
+            for i, word in enumerate(self.vocab.words):
+                vec = " ".join(f"{x:.6f}" for x in rows[i])
+                f.write(f"{word} {vec}\n")
+
+    # -- eval: nearest neighbors for sanity checks --------------------------
+
+    def neighbors(self, state: W2VState, word: str, topn: int = 10):
+        emb = np.asarray(
+            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32)))
+        )
+        norms = np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9
+        emb = emb / norms
+        q = emb[self.vocab.index[word]]
+        sims = emb @ q
+        order = np.argsort(-sims)
+        return [(self.vocab.words[i], float(sims[i])) for i in order[1 : topn + 1]]

@@ -1,0 +1,13 @@
+from swiftsnails_tpu.ops.hashing import (
+    murmur_fmix64,
+    murmur_fmix64_np,
+    murmur_fmix64_pair,
+    hash_row,
+)
+
+__all__ = [
+    "murmur_fmix64",
+    "murmur_fmix64_np",
+    "murmur_fmix64_pair",
+    "hash_row",
+]

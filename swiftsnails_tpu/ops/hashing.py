@@ -1,0 +1,145 @@
+"""Key hashing for parameter placement.
+
+The reference places every parameter key with the MurmurHash3 64-bit
+finalizer (``src/utils/HashFunction.h:17-25``)::
+
+    x ^= x >> 33; x *= 0xff51afd7ed558ccd;
+    x ^= x >> 33; x *= 0xc4ceb9fe1a85ec53;
+    x ^= x >> 33;
+
+then routes it with ``hash % frag_num`` (``src/core/parameter/hashfrag.h:48-53``)
+and within a server with ``hash % shard_num`` (``sparsetable.h:115``).
+
+We keep the exact same mixer so key→row placement is reproducible everywhere:
+
+* :func:`murmur_fmix64_np` — exact, vectorized, host-side (numpy uint64);
+* :func:`murmur_fmix64_pair` / :func:`murmur_fmix64` — exact, **jittable
+  without ``jax_enable_x64``**: the 64-bit value is carried as a
+  ``(hi32, lo32)`` uint32 pair and the modular multiply is done in 16-bit
+  limbs, so the same placement can be computed inside a jit'd step on TPU;
+* :func:`hash_row` — key → table row for a power-of-two capacity table
+  (the hashing-trick replacement for the reference's lazy ``dense_hash_map``
+  insert, ``sparsetable.h:142-149``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_C1 = 0xFF51AFD7ED558CCD
+_C2 = 0xC4CEB9FE1A85EC53
+
+_C1_HI = np.uint32(_C1 >> 32)
+_C1_LO = np.uint32(_C1 & 0xFFFFFFFF)
+_C2_HI = np.uint32(_C2 >> 32)
+_C2_LO = np.uint32(_C2 & 0xFFFFFFFF)
+
+_MASK64 = (1 << 64) - 1
+
+
+def murmur_fmix64_int(x: int) -> int:
+    """Exact scalar finalizer on Python ints (host-side vocab/dict use)."""
+    x &= _MASK64
+    x ^= x >> 33
+    x = (x * _C1) & _MASK64
+    x ^= x >> 33
+    x = (x * _C2) & _MASK64
+    x ^= x >> 33
+    return x
+
+
+def murmur_fmix64_np(x: np.ndarray) -> np.ndarray:
+    """Exact vectorized finalizer on ``uint64`` numpy arrays."""
+    x = np.asarray(x, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint64(33))
+        x = x * np.uint64(_C1)
+        x = x ^ (x >> np.uint64(33))
+        x = x * np.uint64(_C2)
+        x = x ^ (x >> np.uint64(33))
+    return x
+
+
+# -- jittable 64-bit arithmetic on (hi, lo) uint32 pairs ---------------------
+
+
+def _mul32x32_64(a, b):
+    """Full 64-bit product of two uint32 arrays, as a (hi, lo) uint32 pair.
+
+    Uses 16-bit limbs so every partial product fits in uint32 — this is what
+    lets the exact murmur mixer run in-graph without ``jax_enable_x64``.
+    """
+    a = a.astype(jnp.uint32)
+    b = b.astype(jnp.uint32)
+    mask16 = jnp.uint32(0xFFFF)
+    a0, a1 = a & mask16, a >> 16
+    b0, b1 = b & mask16, b >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> 16) + (p01 & mask16) + (p10 & mask16)
+    lo = (p00 & mask16) | ((mid & mask16) << 16)
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def _mul64_lo(x_hi, x_lo, c_hi, c_lo):
+    """(x * c) mod 2**64 where x is a (hi, lo) pair and c a constant pair."""
+    hi, lo = _mul32x32_64(x_lo, c_lo)
+    hi = hi + x_lo * c_hi + x_hi * c_lo  # uint32 wrap == mod 2**32
+    return hi, lo
+
+
+def _xorshift33(hi, lo):
+    # x ^= x >> 33  ==  lo ^= hi >> 1 (hi unchanged: top 33 bits of the shift are 0)
+    return hi, lo ^ (hi >> 1)
+
+
+def murmur_fmix64_pair(hi, lo):
+    """Exact murmur fmix64 on (hi32, lo32) uint32 pairs. Jittable."""
+    hi = jnp.asarray(hi, dtype=jnp.uint32)
+    lo = jnp.asarray(lo, dtype=jnp.uint32)
+    hi, lo = _xorshift33(hi, lo)
+    hi, lo = _mul64_lo(hi, lo, jnp.uint32(_C1_HI), jnp.uint32(_C1_LO))
+    hi, lo = _xorshift33(hi, lo)
+    hi, lo = _mul64_lo(hi, lo, jnp.uint32(_C2_HI), jnp.uint32(_C2_LO))
+    hi, lo = _xorshift33(hi, lo)
+    return hi, lo
+
+
+def murmur_fmix64(keys):
+    """Finalize 32-bit keys (zero-extended to 64-bit), returning a (hi, lo) pair.
+
+    ``keys`` may be int32/uint32; negative int32 values are reinterpreted as
+    their uint32 bit pattern (matching a C++ ``uint64_t`` widening of uint32).
+    """
+    lo = jnp.asarray(keys).astype(jnp.uint32)
+    hi = jnp.zeros_like(lo)
+    return murmur_fmix64_pair(hi, lo)
+
+
+def hash_row(keys, capacity: int):
+    """key → table row: ``murmur(key) % capacity`` with power-of-two capacity.
+
+    This replaces the reference's two-level placement (``hash % frag_num`` →
+    server, lazy hashmap insert within the shard) with one static mapping into
+    a pre-initialized ``capacity``-row table. Power-of-two capacity makes the
+    modulo a mask on the low hash word, which keeps the op exact in uint32
+    (general modulo of a 64-bit value needs 64-bit arithmetic; do that on the
+    host with :func:`murmur_fmix64_np` if a non-pow2 capacity is ever needed).
+    """
+    if capacity <= 0 or (capacity & (capacity - 1)) != 0:
+        raise ValueError(f"capacity must be a positive power of two, got {capacity}")
+    _, lo = murmur_fmix64(keys)
+    if capacity > (1 << 32):
+        raise ValueError("on-device hash_row supports capacity <= 2**32")
+    return (lo & jnp.uint32(capacity - 1)).astype(jnp.int32)
+
+
+def hash_row_np(keys: np.ndarray, capacity: int) -> np.ndarray:
+    """Host-side equivalent of :func:`hash_row` (exact for any capacity)."""
+    h = murmur_fmix64_np(np.asarray(keys, dtype=np.uint64))
+    return (h % np.uint64(capacity)).astype(np.int64)

@@ -1,0 +1,24 @@
+from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, table_sharding
+from swiftsnails_tpu.parallel.access import AccessMethod, SgdAccess, AdaGradAccess
+from swiftsnails_tpu.parallel.store import (
+    TableState,
+    create_table,
+    merge_duplicate_rows,
+    pull,
+    push,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "table_sharding",
+    "AccessMethod",
+    "SgdAccess",
+    "AdaGradAccess",
+    "TableState",
+    "create_table",
+    "merge_duplicate_rows",
+    "pull",
+    "push",
+]

@@ -1,0 +1,89 @@
+"""Pluggable parameter access methods (update rules).
+
+Capability parity with the reference's ``PullAccessMethod`` /
+``PushAccessMethod`` interfaces (``src/core/parameter/sparse_access_method.h:10-48``):
+
+* ``init_param``        -> :meth:`AccessMethod.init_param` (but eager: the whole
+  hashed table is initialized at creation instead of lazily per key,
+  replacing the dense_hash_map find-or-insert of ``sparsetable.h:142-149``);
+* ``get_pull_value``    -> :meth:`AccessMethod.get_pull_value`;
+* ``merge_push_value``  -> additive merge, performed batch-wide by
+  :func:`swiftsnails_tpu.parallel.store.merge_duplicate_rows` (segment-sum);
+* ``apply_push_value``  -> :meth:`AccessMethod.apply_push_value`, vectorized
+  over the batch's unique rows instead of per-key virtual calls.
+
+Optimizer state ("slots", e.g. the AdaGrad accumulator) lives row-aligned with
+the table so it shards identically (SURVEY §2.5: "AdaGrad accumulator lives
+alongside params in the sharded pytree").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Slots = Dict[str, jax.Array]
+
+
+class AccessMethod:
+    """Base update rule. Subclass and override; all methods are jit-safe."""
+
+    def init_param(self, rng: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+        """Initial parameter values.
+
+        Default matches the reference's ``Vec::randInit``: U(-0.5, 0.5)/dim
+        (``src/utils/vec1.h:223-226``) — the classic word2vec embedding init.
+        """
+        dim = shape[-1] if len(shape) > 1 else 1
+        return jax.random.uniform(rng, shape, dtype=dtype, minval=-0.5, maxval=0.5) / dim
+
+    def init_slots(self, shape: Tuple[int, ...], dtype) -> Slots:
+        """Zero-initialized optimizer slot arrays, row-aligned with the table."""
+        return {}
+
+    def get_pull_value(self, param: jax.Array) -> jax.Array:
+        """Transform stored param -> pulled value (identity by default)."""
+        return param
+
+    def apply_push_value(
+        self, param: jax.Array, slots: Slots, grad: jax.Array, lr: jax.Array
+    ) -> Tuple[jax.Array, Slots]:
+        """Apply merged gradients to a batch of rows. Must be pure.
+
+        ``grad`` follows the reference's push convention: it is the value to
+        *subtract* scaled by ``lr`` for plain SGD (workers push raw gradients;
+        the server's access method owns the update rule,
+        ``server/init.h:115-135``).
+        """
+        raise NotImplementedError
+
+
+class SgdAccess(AccessMethod):
+    """Plain SGD: ``param -= lr * grad``."""
+
+    def apply_push_value(self, param, slots, grad, lr):
+        return param - lr * grad.astype(param.dtype), slots
+
+
+class AdaGradAccess(AccessMethod):
+    """AdaGrad: ``accum += grad**2; param -= lr * grad / sqrt(accum + eps)``.
+
+    The Wide&Deep / CTR update rule from BASELINE.json. ``accum`` doubles
+    table memory; ``slot_dtype`` allows bf16 compression for 1B-row configs.
+    """
+
+    def __init__(self, eps: float = 1e-8, slot_dtype=None):
+        self.eps = eps
+        self.slot_dtype = slot_dtype
+
+    def init_slots(self, shape, dtype):
+        return {"accum": jnp.zeros(shape, dtype=self.slot_dtype or dtype)}
+
+    def apply_push_value(self, param, slots, grad, lr):
+        g = grad.astype(jnp.float32)
+        accum = slots["accum"].astype(jnp.float32) + g * g
+        step = lr * g * jax.lax.rsqrt(accum + self.eps)
+        new_param = param - step.astype(param.dtype)
+        return new_param, {"accum": accum.astype(slots["accum"].dtype)}

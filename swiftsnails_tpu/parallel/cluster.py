@@ -1,0 +1,90 @@
+"""Multi-host runtime: rendezvous, lifecycle, data sharding.
+
+Replaces the reference's entire cluster system (``src/core/system/``,
+survey §2.4):
+
+* master rendezvous + route broadcast (``MasterTransferInit``,
+  ``master/init.h:21-171``; ``NodeTransferInit``, ``node_init.h:16-94``)
+  -> :func:`initialize_cluster` — ``jax.distributed.initialize`` against a
+  coordinator address; process ids come from the coordination service instead
+  of the master's id-allocation protocol (``ServerWorkerRoute.h:17-31``);
+* init barriers with ``init_timeout`` + CHECK-crash (``node_init.h:73-84``)
+  -> the coordination service's own timeout, configured from the same key;
+* end-of-training barrier + terminate broadcast (``MasterTerminate``,
+  ``master/terminate.h:15-109``; ``ClientTerminate``) -> :func:`barrier`
+  over all hosts;
+* Hadoop-Streaming stdin data splits (``run_worker.sh``: ``cat > data.txt``)
+  -> :func:`local_data_shard` by process index.
+
+Config keys honored (reference inventory, survey §2.9): ``master_addr``
+(coordinator address), ``expected_node_num`` (process count),
+``init_timeout`` (seconds).
+
+Single-process mode (the reference's ``local_train``) needs none of this:
+every function degrades to a no-op/identity.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from swiftsnails_tpu.utils.config import Config
+
+log = logging.getLogger("swiftsnails_tpu.cluster")
+
+
+def initialize_cluster(config: Optional[Config] = None, process_id: Optional[int] = None) -> None:
+    """Join the cluster (NodeTransferInit + MasterTransferInit equivalent).
+
+    With ``master_addr`` and ``expected_node_num > 1`` in config, calls
+    ``jax.distributed.initialize``. Without them (or with
+    ``expected_node_num <= 1``), this is single-process mode and a no-op.
+    """
+    if config is None:
+        return
+    num_processes = config.get_int("expected_node_num", 1)
+    if num_processes <= 1:
+        return
+    coordinator = config.get_str("master_addr")
+    timeout_s = config.get_int("init_timeout", 300)
+    kwargs = {}
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        initialization_timeout=timeout_s,
+        **kwargs,
+    )
+    log.info(
+        "joined cluster: process %d/%d via %s",
+        jax.process_index(), jax.process_count(), coordinator,
+    )
+
+
+def process_info() -> Tuple[int, int]:
+    """(process_index, process_count) — the reference's node id / node num."""
+    return jax.process_index(), jax.process_count()
+
+
+def barrier(name: str = "swiftsnails_barrier") -> None:
+    """All-host sync (MasterTerminate/ClientTerminate equivalent)."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def local_data_shard(paths: Sequence[str]) -> List[str]:
+    """Partition input files across hosts (Hadoop stdin-split equivalent).
+
+    Files are assigned round-robin by process index; with fewer files than
+    processes, callers should fall back to record-level sharding
+    (:func:`swiftsnails_tpu.data.text.iter_line_records`).
+    """
+    idx, count = process_info()
+    return [p for i, p in enumerate(paths) if i % count == idx]

@@ -1,0 +1,77 @@
+"""Device mesh construction.
+
+The reference's cluster topology is a peer table of master/server/worker
+processes (``src/core/system/ServerWorkerRoute.h:14-84``). On TPU the roles
+dissolve into one SPMD mesh with named axes:
+
+* ``data``  — batch parallelism (the reference's M workers);
+* ``model`` — parameter-table row sharding (the reference's N servers /
+  ``frag_num`` hash fragments, ``src/core/parameter/hashfrag.h:30-53``).
+
+A ``seq`` axis slot is reserved for sequence/context parallelism (ring
+attention; module planned as ``swiftsnails_tpu.parallel.sequence``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh over ``devices``.
+
+    ``shape`` maps axis name -> size; at most one axis may be ``-1`` (inferred
+    so the product covers every device). Default: all devices on the ``data``
+    axis with a trivial ``model`` axis — the safe single-chip / pure-DP layout.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if shape is None:
+        shape = {DATA_AXIS: n, MODEL_AXIS: 1}
+    names = list(shape.keys())
+    sizes = list(shape.values())
+    if sizes.count(-1) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {shape}")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if known == 0 or n % known != 0:
+            raise ValueError(f"cannot infer -1 axis: {n} devices, shape {shape}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} does not cover {n} devices")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def table_sharding(mesh: Mesh, axis: str = MODEL_AXIS) -> NamedSharding:
+    """Row-sharding spec for a parameter table: shard dim 0 over ``axis``.
+
+    This is the TPU equivalent of the reference's hash fragmentation across
+    servers (``hashfrag.h:30-46``): contiguous row ranges per device instead
+    of a frag->server map.
+    """
+    return NamedSharding(mesh, P(axis, None))
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Batch sharding: leading dim over the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

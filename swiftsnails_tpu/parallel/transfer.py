@@ -1,0 +1,103 @@
+"""Explicit-collective pull/push — the Transfer/RPC layer, TPU-native.
+
+The reference's universal substrate is an async RPC round trip (survey §3.4):
+``Transfer::send`` -> ZeroMQ -> remote handler -> response callback, fanned out
+per server and joined on a ``StateBarrier`` (``src/core/transfer/transfer.h:55-268``,
+``global_pull_access.h:40-55``, ``global_push_access.h:36-53``).
+
+Here the same two protocols are written as explicit XLA collectives inside
+``shard_map`` over a ``(data, model)`` mesh, so the communication pattern is
+visible and pinned rather than left to the SPMD partitioner:
+
+* **pull**  (WORKER_PULL_REQUEST): every model shard gathers the rows it owns
+  for the local data shard's keys, others contribute zeros; a ``psum`` over
+  ``model`` assembles full rows on every device. One all-reduce over ICI
+  replaces the per-server request/response fan-out.
+* **push**  (WORKER_PUSH_REQUEST): the (rows, grads) batch is ``all_gather``\\ ed
+  along ``data`` (workers "send" their gradients), then each model shard
+  merges duplicates and applies its owned rows through the access method.
+  Replica consistency over ``data`` is by construction: every replica sees the
+  same gathered batch and computes the identical update.
+
+:func:`swiftsnails_tpu.parallel.store.pull` / ``push`` are the pjit
+auto-partitioned equivalents; tests assert both paths agree bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from swiftsnails_tpu.parallel.access import AccessMethod
+from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+from swiftsnails_tpu.parallel.store import TableState, apply_rows, merge_duplicate_rows
+
+
+def _rows_per_shard(capacity: int, mesh: Mesh) -> int:
+    model = mesh.shape[MODEL_AXIS]
+    if capacity % model != 0:
+        raise ValueError(f"capacity {capacity} not divisible by model axis {model}")
+    return capacity // model
+
+
+def pull_collective(mesh: Mesh, state: TableState, rows: jax.Array) -> jax.Array:
+    """Sharded gather with explicit psum-over-model (pull protocol)."""
+    per = _rows_per_shard(state.capacity, mesh)
+
+    def local_pull(table_shard, rows_local):
+        m = lax.axis_index(MODEL_AXIS)
+        local_ids = rows_local - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        vals = table_shard.at[jnp.where(owned, local_ids, 0)].get(mode="promise_in_bounds")
+        vals = jnp.where(owned[:, None], vals, 0)
+        return lax.psum(vals, MODEL_AXIS)
+
+    fn = shard_map(
+        local_pull,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS, None),
+        check_vma=False,
+    )
+    return fn(state.table, rows)
+
+
+def push_collective(
+    mesh: Mesh,
+    state: TableState,
+    rows: jax.Array,
+    grads: jax.Array,
+    access: AccessMethod,
+    lr,
+) -> TableState:
+    """Sharded scatter-update with explicit all_gather-over-data (push protocol)."""
+    per = _rows_per_shard(state.capacity, mesh)
+    slot_keys = sorted(state.slots.keys())
+
+    def local_push(table_shard, slot_shards, rows_local, grads_local):
+        rows_all = lax.all_gather(rows_local, DATA_AXIS, tiled=True)
+        grads_all = lax.all_gather(grads_local, DATA_AXIS, tiled=True)
+        m = lax.axis_index(MODEL_AXIS)
+        local_ids = rows_all - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        local_ids = jnp.where(owned, local_ids, per)  # unowned -> out of range
+        grads_all = jnp.where(owned[:, None], grads_all, 0)
+        uniq, merged = merge_duplicate_rows(local_ids, grads_all, invalid_row=per)
+        return apply_rows(table_shard, slot_shards, uniq, merged, access, lr)
+
+    shard_spec = P(MODEL_AXIS, None)
+    fn = shard_map(
+        local_push,
+        mesh=mesh,
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
+        check_vma=False,
+    )
+    table, slots = fn(state.table, dict(state.slots), rows, grads)
+    return TableState(table=table, slots=slots)

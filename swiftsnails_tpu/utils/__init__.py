@@ -1,0 +1,13 @@
+from swiftsnails_tpu.utils.config import Config, global_config, load_config
+from swiftsnails_tpu.utils.flags import CmdLine
+from swiftsnails_tpu.utils.metrics import MetricsLogger
+from swiftsnails_tpu.utils.timer import Timer
+
+__all__ = [
+    "Config",
+    "global_config",
+    "load_config",
+    "CmdLine",
+    "MetricsLogger",
+    "Timer",
+]

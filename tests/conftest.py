@@ -1,0 +1,44 @@
+"""Test harness: single-process 8-device CPU mesh.
+
+The reference validated distributed behavior with a loopback Transfer fixture
+(one process sending RPCs to itself, ``unitest/core/transfer/transfer_test.h:36-81``).
+The modern analog — and our substrate for every sharding test — is XLA's
+virtual host platform: 8 CPU devices in one process exercising the real
+pjit/shard_map code path (SURVEY §4).
+
+Env vars must be set before jax initializes its backends, hence this conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # the shell pins a TPU platform; tests run on CPU
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) re-pins jax_platforms after env vars are
+# read; override it before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_global_config():
+    """Isolate tests from the process-wide config singleton."""
+    from swiftsnails_tpu.utils.config import global_config
+
+    global_config().clear()
+    yield
+    global_config().clear()

@@ -1,0 +1,23 @@
+"""Cluster runtime in single-process mode (the reference's local_train path);
+true multi-host behavior is validated by the driver's dryrun + real pods."""
+
+from swiftsnails_tpu.parallel.cluster import (
+    barrier,
+    initialize_cluster,
+    local_data_shard,
+    process_info,
+)
+from swiftsnails_tpu.utils.config import Config
+
+
+def test_single_process_noop():
+    initialize_cluster(None)
+    initialize_cluster(Config({"expected_node_num": "1"}))
+    idx, count = process_info()
+    assert idx == 0 and count == 1
+    barrier()  # must not hang or require a cluster
+
+
+def test_local_data_shard_identity_single_process():
+    paths = [f"part-{i}" for i in range(5)]
+    assert local_data_shard(paths) == paths

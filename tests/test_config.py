@@ -1,0 +1,106 @@
+"""Config parser parity tests (reference ``unitest/utils/ConfigParser_test.h``
+against fixture ``unitest/1.conf`` with ``ip``/``thread_num`` keys)."""
+
+import os
+
+import pytest
+
+from swiftsnails_tpu.utils.config import Config, ConfigError, global_config, load_config
+from swiftsnails_tpu.utils.flags import CmdLine, parse_role_argv
+
+
+def write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def test_basic_kv_and_types(tmp_path):
+    path = write(
+        tmp_path,
+        "1.conf",
+        "ip: 127.0.0.1\n"
+        "thread_num: 4   # trailing comment\n"
+        "\n"
+        "# full-line comment\n"
+        "learning_rate: 0.05\n"
+        "local_train: 1\n",
+    )
+    cfg = load_config(path)
+    assert cfg.get_str("ip") == "127.0.0.1"
+    assert cfg.get_int("thread_num") == 4
+    assert cfg.get_float("learning_rate") == pytest.approx(0.05)
+    assert cfg.get_bool("local_train") is True
+
+
+def test_missing_key_raises(tmp_path):
+    cfg = Config()
+    with pytest.raises(ConfigError):
+        cfg.get("nope")
+    assert cfg.get_int("nope", 7) == 7
+
+
+def test_import_recursive(tmp_path):
+    base = write(tmp_path, "base.conf", "frag_num: 100\nshard_num: 8\n")
+    main = write(tmp_path, "main.conf", f"import {os.path.basename(base)}\nshard_num: 16\n")
+    cfg = load_config(main)
+    assert cfg.get_int("frag_num") == 100
+    # later keys override imported ones
+    assert cfg.get_int("shard_num") == 16
+
+
+def test_import_cycle_raises(tmp_path):
+    a = tmp_path / "a.conf"
+    b = tmp_path / "b.conf"
+    a.write_text(f"import {b}\n")
+    b.write_text(f"import {a}\n")
+    with pytest.raises(ConfigError):
+        load_config(str(a))
+
+
+def test_bad_line_raises(tmp_path):
+    path = write(tmp_path, "bad.conf", "just a dangling line\n")
+    with pytest.raises(ConfigError):
+        load_config(path)
+
+
+def test_global_config_singleton():
+    global_config().set("k", "v")
+    assert global_config().get_str("k") == "v"
+
+
+def test_cmdline_flags():
+    cmd = CmdLine()
+    cmd.register_help("config", "config path")
+    cmd.register_help("data", "data path")
+    cmd.register_help("dims", "list value")
+    cmd.parse(["-config", "a.conf", "-data", "d.txt", "-dims", "8;16,32"])
+    assert cmd.get_str("config") == "a.conf"
+    assert cmd.get_list("dims") == ["8", "16", "32"]
+    with pytest.raises(ConfigError):
+        bad = CmdLine()
+        bad.register_help("x", "")
+        bad.parse(["-unknown", "1"])
+
+
+def test_value_containing_other_separator(tmp_path):
+    # "key = value" with ':' in the value must split at the first separator
+    path = write(tmp_path, "sep.conf", "data = hdfs://namenode/corpus\nurl: http://x/y?a=1\n")
+    cfg = load_config(path)
+    assert cfg.get_str("data") == "hdfs://namenode/corpus"
+    assert cfg.get_str("url") == "http://x/y?a=1"
+
+
+def test_cmdline_negative_number_value():
+    cmd = CmdLine()
+    cmd.parse(["-learning_rate", "-0.5", "-offset", "-3"])
+    assert cmd.get_float("learning_rate") == pytest.approx(-0.5)
+    assert cmd.get_int("offset") == -3
+
+
+def test_parse_role_argv(tmp_path):
+    path = write(tmp_path, "w.conf", "num_iters: 3\nlearning_rate: 0.1\n")
+    cfg = parse_role_argv(["-config", path, "-num_iters", "5"])
+    # flag overrides file
+    assert cfg.get_int("num_iters") == 5
+    assert cfg.get_float("learning_rate") == pytest.approx(0.1)

@@ -1,0 +1,105 @@
+"""Data pipeline tests: vocab, pair generation, alias sampling."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.data.sampler import (
+    alias_sample,
+    batch_stream,
+    build_alias,
+    build_unigram_alias,
+    skipgram_pairs,
+    subsample_mask,
+)
+from swiftsnails_tpu.data.text import encode_corpus, iter_line_records
+from swiftsnails_tpu.data.vocab import Vocab
+
+
+def test_vocab_build_rank_and_min_count():
+    tokens = ["a"] * 5 + ["b"] * 3 + ["c"] * 2 + ["d"]
+    v = Vocab.build(tokens, min_count=2)
+    assert v.words == ["a", "b", "c"]
+    assert v.index["a"] == 0
+    np.testing.assert_array_equal(v.counts, [5, 3, 2])
+    ids = v.encode(["a", "d", "c", "b"])  # OOV 'd' dropped
+    np.testing.assert_array_equal(ids, [0, 2, 1])
+
+
+def test_vocab_save_load(tmp_path):
+    v = Vocab.build(["x"] * 4 + ["y"] * 2, min_count=1)
+    p = str(tmp_path / "vocab.txt")
+    v.save(p)
+    w = Vocab.load(p)
+    assert w.words == v.words
+    np.testing.assert_array_equal(w.counts, v.counts)
+
+
+def test_encode_corpus(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("the cat sat on the mat the cat\n")
+    ids, vocab = encode_corpus(str(p), min_count=2)
+    assert set(vocab.words) == {"the", "cat"}
+    assert len(ids) == 5  # 3x the + 2x cat
+
+
+def test_iter_line_records_sharding(tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("\n".join(str(i) for i in range(10)) + "\n")
+    got0 = list(iter_line_records(str(p), 0, 3))
+    got1 = list(iter_line_records(str(p), 1, 3))
+    got2 = list(iter_line_records(str(p), 2, 3))
+    assert got0 == ["0", "3", "6", "9"]
+    assert sorted(int(x) for x in got0 + got1 + got2) == list(range(10))
+
+
+def test_skipgram_pairs_window1_static():
+    ids = np.array([10, 20, 30], dtype=np.int32)
+    rng = np.random.default_rng(0)
+    centers, contexts = skipgram_pairs(ids, window=1, rng=rng, dynamic=False)
+    pairs = set(zip(centers.tolist(), contexts.tolist()))
+    assert pairs == {(10, 20), (20, 10), (20, 30), (30, 20)}
+
+
+def test_skipgram_pairs_dynamic_within_window():
+    ids = np.arange(100, dtype=np.int32)
+    rng = np.random.default_rng(1)
+    centers, contexts = skipgram_pairs(ids, window=5, rng=rng, dynamic=True)
+    assert len(centers) == len(contexts) > 0
+    # every pair must be within the max window
+    assert np.all(np.abs(centers - contexts) <= 5)
+    assert np.all(centers != contexts)
+
+
+def test_alias_table_distribution():
+    weights = np.array([1.0, 2.0, 4.0, 8.0])
+    prob, alias = build_alias(weights)
+    table = build_unigram_alias(np.array([1, 2, 4, 8]), power=1.0)
+    draws = np.asarray(
+        jax.jit(lambda r: alias_sample(table, r, (200_000,)))(jax.random.PRNGKey(0))
+    )
+    freq = np.bincount(draws, minlength=4) / len(draws)
+    np.testing.assert_allclose(freq, weights / weights.sum(), atol=0.01)
+
+
+def test_subsample_keeps_rare_drops_frequent():
+    counts = np.array([1_000_000, 10], dtype=np.int64)
+    ids = np.array([0] * 1000 + [1] * 1000, dtype=np.int32)
+    rng = np.random.default_rng(2)
+    mask = subsample_mask(ids, counts, threshold=1e-4, rng=rng)
+    kept_frequent = mask[:1000].mean()
+    kept_rare = mask[1000:].mean()
+    assert kept_rare == 1.0
+    assert kept_frequent < 0.5
+
+
+def test_batch_stream_exact_batches():
+    centers = np.arange(10, dtype=np.int32)
+    contexts = np.arange(10, dtype=np.int32) + 100
+    batches = list(batch_stream(centers, contexts, 4, np.random.default_rng(0)))
+    assert len(batches) == 2
+    for b in batches:
+        assert b["centers"].shape == (4,)
+        np.testing.assert_array_equal(b["contexts"] - b["centers"], 100)

@@ -1,0 +1,82 @@
+"""Hash parity: the jittable (hi,lo)-pair mixer must equal uint64 ground truth
+(reference mixer at ``src/utils/HashFunction.h:17-25``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.ops.hashing import (
+    hash_row,
+    hash_row_np,
+    murmur_fmix64,
+    murmur_fmix64_int,
+    murmur_fmix64_np,
+    murmur_fmix64_pair,
+)
+
+
+def ref_fmix64(x: int) -> int:
+    mask = (1 << 64) - 1
+    x &= mask
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & mask
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & mask
+    x ^= x >> 33
+    return x
+
+
+SAMPLES = [0, 1, 2, 3, 42, 0xDEADBEEF, (1 << 32) - 1, (1 << 63) + 12345, (1 << 64) - 1]
+
+
+@pytest.mark.parametrize("x", SAMPLES)
+def test_scalar_matches_reference(x):
+    assert murmur_fmix64_int(x) == ref_fmix64(x)
+
+
+def test_numpy_matches_reference():
+    xs = np.array(SAMPLES, dtype=np.uint64)
+    got = murmur_fmix64_np(xs)
+    want = np.array([ref_fmix64(int(x)) for x in SAMPLES], dtype=np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pair_matches_uint64_no_x64():
+    """The in-graph uint32-limb mixer must be bit-exact vs numpy uint64."""
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 1 << 64, size=4096, dtype=np.uint64)
+    hi = (xs >> np.uint64(32)).astype(np.uint32)
+    lo = (xs & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    got_hi, got_lo = jax.jit(murmur_fmix64_pair)(jnp.asarray(hi), jnp.asarray(lo))
+    want = murmur_fmix64_np(xs)
+    np.testing.assert_array_equal(
+        np.asarray(got_hi).astype(np.uint64), (want >> np.uint64(32)).astype(np.uint64) & np.uint64(0xFFFFFFFF)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got_lo).astype(np.uint64), want & np.uint64(0xFFFFFFFF)
+    )
+
+
+def test_hash_row_matches_host():
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 1 << 32, size=2048, dtype=np.uint32)
+    cap = 1 << 20
+    rows_dev = np.asarray(jax.jit(lambda k: hash_row(k, cap))(jnp.asarray(keys)))
+    rows_host = hash_row_np(keys, cap)
+    np.testing.assert_array_equal(rows_dev.astype(np.int64), rows_host)
+    assert rows_dev.min() >= 0 and rows_dev.max() < cap
+
+
+def test_hash_row_rejects_non_pow2():
+    with pytest.raises(ValueError):
+        hash_row(jnp.arange(4), 100)
+
+
+def test_int32_keys_widen_as_uint32():
+    keys = jnp.array([-1, -2, 7], dtype=jnp.int32)
+    hi, lo = murmur_fmix64(keys)
+    want = murmur_fmix64_np(np.array([0xFFFFFFFF, 0xFFFFFFFE, 7], dtype=np.uint64))
+    got = (np.asarray(hi).astype(np.uint64) << np.uint64(32)) | np.asarray(lo).astype(np.uint64)
+    np.testing.assert_array_equal(got, want)
