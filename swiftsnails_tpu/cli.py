@@ -1,0 +1,120 @@
+"""CLI entry points — the reference's role binaries, collapsed TPU-style.
+
+Reference contract (survey §2.7): per-app ``master``/``server``/``worker``
+binaries taking ``-config <file>`` (``src/tools/run_master.sh``) and workers
+additionally ``-data <file>`` (``run_worker.sh``), launched by Hadoop
+Streaming. On TPU the three roles dissolve into one SPMD ``train`` role: the
+parameter table lives sharded across the same processes that compute
+(survey §7 design stance), and rendezvous is the JAX coordination service.
+
+Usage::
+
+    python -m swiftsnails_tpu train  -config train.conf [-data corpus.txt]
+    python -m swiftsnails_tpu export -config train.conf -checkpoint ROOT -out vec.txt
+    python -m swiftsnails_tpu models
+    python -m swiftsnails_tpu worker -config ...   # alias of train (parity)
+
+``master`` / ``server`` are accepted for parity and explain the collapse.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from swiftsnails_tpu.utils.config import Config, ConfigError, global_config
+from swiftsnails_tpu.utils.flags import parse_role_argv
+from swiftsnails_tpu.utils.metrics import MetricsLogger
+
+
+def _build_trainer(cfg: Config):
+    from swiftsnails_tpu.models.registry import get_model
+    from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+    import jax
+
+    model_name = cfg.get_str("model", "word2vec")
+    trainer_cls = get_model(model_name)
+    n = len(jax.devices())
+    if cfg.get_bool("local_train", False) or n == 1:
+        mesh = None  # reference local_train parity (SwiftWorker.h:114-123)
+    else:
+        model_axis = cfg.get_int("model_axis", 0)
+        if model_axis <= 0:
+            model_axis = next((c for c in (4, 2, 1) if n % c == 0 and n > c), 1)
+        mesh = make_mesh({DATA_AXIS: n // model_axis, MODEL_AXIS: model_axis})
+    return trainer_cls(cfg, mesh=mesh)
+
+
+def cmd_train(argv: List[str]) -> int:
+    from swiftsnails_tpu.framework.trainer import TrainLoop
+    from swiftsnails_tpu.parallel.cluster import barrier, initialize_cluster
+
+    cfg = parse_role_argv(argv)
+    initialize_cluster(cfg)
+    trainer = _build_trainer(cfg)
+    metrics = MetricsLogger(path=cfg.get_str("metrics_path", "") or None, echo=True)
+    loop = TrainLoop(trainer, metrics=metrics, log_every=cfg.get_int("log_every", 100))
+    state = loop.run(seed=cfg.get_int("seed", 0))
+    barrier("end_of_training")  # MasterTerminate parity
+    out = cfg.get_str("output", "")
+    if out:
+        trainer.export_text(state, out)
+        print(f"exported parameters to {out}", file=sys.stderr)
+    return 0
+
+
+def cmd_export(argv: List[str]) -> int:
+    from swiftsnails_tpu.framework.checkpoint import restore_checkpoint
+
+    cfg = parse_role_argv(argv)
+    trainer = _build_trainer(cfg)
+    root = cfg.get_str("checkpoint")
+    out = cfg.get_str("out")
+    state = restore_checkpoint(root, trainer.init_state())
+    trainer.export_text(state, out)
+    print(f"exported {root} -> {out}", file=sys.stderr)
+    return 0
+
+
+def cmd_models(argv: List[str]) -> int:
+    from swiftsnails_tpu.models.registry import available_models
+
+    for name in available_models():
+        print(name)
+    return 0
+
+
+_ROLE_NOTE = (
+    "swiftsnails_tpu has no separate {role} role: the parameter table lives\n"
+    "sharded across the same TPU processes that train. Run\n"
+    "  python -m swiftsnails_tpu train -config <file>\n"
+    "on every host (jax.distributed handles rendezvous via master_addr)."
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    try:
+        if cmd in ("train", "worker"):
+            return cmd_train(rest)
+        if cmd == "export":
+            return cmd_export(rest)
+        if cmd == "models":
+            return cmd_models(rest)
+        if cmd in ("master", "server"):
+            print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
+            return 0
+        print(f"unknown command {cmd!r}; try: train, export, models", file=sys.stderr)
+        return 2
+    except ConfigError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

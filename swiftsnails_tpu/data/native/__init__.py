@@ -1,0 +1,284 @@
+"""ctypes bindings for the native data-pipeline core (libsnails.cpp).
+
+Compiled on demand with g++ (no pybind11 — plain C ABI + ctypes, per the
+environment's binding guidance) and cached next to the source. Every entry
+point has a pure-Python fallback in :mod:`swiftsnails_tpu.data`; callers check
+:func:`available` or rely on the wrappers which raise cleanly when the
+toolchain is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "libsnails.cpp")
+_SO = os.path.join(_DIR, "libsnails.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile the shared library if stale; returns error text or None."""
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return None
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"g++ invocation failed: {e}"
+    if proc.returncode != 0:
+        return f"g++ failed:\n{proc.stderr}"
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    if _lib is not None or _build_error is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _build_error = err
+            return None
+        lib = ctypes.CDLL(_SO)
+        c = ctypes
+        lib.ssn_murmur64.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+        lib.ssn_hash_row.argtypes = [c.c_void_p, c.c_int64, c.c_uint64, c.c_void_p]
+        lib.ssn_vocab_build.restype = c.c_void_p
+        lib.ssn_vocab_build.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.ssn_vocab_size.restype = c.c_int64
+        lib.ssn_vocab_size.argtypes = [c.c_void_p]
+        lib.ssn_vocab_counts.argtypes = [c.c_void_p, c.c_void_p]
+        lib.ssn_vocab_word.restype = c.c_int
+        lib.ssn_vocab_word.argtypes = [c.c_void_p, c.c_int64, c.c_char_p, c.c_int]
+        lib.ssn_vocab_free.argtypes = [c.c_void_p]
+        lib.ssn_encode.restype = c.c_int64
+        lib.ssn_encode.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p, c.c_int64]
+        lib.ssn_skipgram_pairs.restype = c.c_int64
+        lib.ssn_skipgram_pairs.argtypes = [
+            c.c_void_p, c.c_int64, c.c_int, c.c_uint64, c.c_int,
+            c.c_void_p, c.c_void_p, c.c_int64,
+        ]
+        lib.ssn_subsample.restype = c.c_int64
+        lib.ssn_subsample.argtypes = [
+            c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
+            c.c_double, c.c_double, c.c_uint64, c.c_void_p,
+        ]
+        lib.ssn_read_ctr.restype = c.c_int64
+        lib.ssn_read_ctr.argtypes = [c.c_char_p, c.c_int, c.c_void_p, c.c_void_p, c.c_int64]
+        lib.ssn_prefetch_open.restype = c.c_void_p
+        lib.ssn_prefetch_open.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_int, c.c_int, c.c_uint64,
+        ]
+        lib.ssn_prefetch_next.restype = c.c_int
+        lib.ssn_prefetch_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+        lib.ssn_prefetch_close.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def _require():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native pipeline unavailable: {_build_error}")
+    return lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def murmur64(x: np.ndarray) -> np.ndarray:
+    lib = _require()
+    x = np.ascontiguousarray(x, dtype=np.uint64)
+    out = np.empty_like(x)
+    lib.ssn_murmur64(_ptr(x), _ptr(out), x.size)
+    return out
+
+
+def hash_row(keys: np.ndarray, capacity: int) -> np.ndarray:
+    lib = _require()
+    keys = np.ascontiguousarray(keys, dtype=np.uint32)
+    out = np.empty(keys.size, dtype=np.int64)
+    lib.ssn_hash_row(_ptr(keys), keys.size, capacity, _ptr(out))
+    return out
+
+
+class NativeVocab:
+    """C++ vocab builder (reference hashmap.h + scan_file_by_line parity)."""
+
+    def __init__(self, path: str, min_count: int = 5, max_size: int = 0):
+        lib = _require()
+        self._lib = lib
+        self._h = lib.ssn_vocab_build(path.encode(), min_count, max_size)
+        if not self._h:
+            raise OSError(f"cannot read {path}")
+
+    def __len__(self) -> int:
+        return int(self._lib.ssn_vocab_size(self._h))
+
+    def counts(self) -> np.ndarray:
+        out = np.empty(len(self), dtype=np.int64)
+        self._lib.ssn_vocab_counts(self._h, _ptr(out))
+        return out
+
+    def words(self) -> List[str]:
+        buf = ctypes.create_string_buffer(65536)
+        out = []
+        for i in range(len(self)):
+            n = self._lib.ssn_vocab_word(self._h, i, buf, len(buf))
+            if n < 0:
+                raise ValueError(f"word {i} too long")
+            out.append(buf.value.decode("utf-8", "replace"))
+        return out
+
+    def encode_file(self, path: str) -> np.ndarray:
+        # Size guess: for the vocab's own source file the kept-token count is
+        # exactly counts().sum(), avoiding a second full tokenize pass. For a
+        # different file the guess may be short; ssn_encode then returns the
+        # true count negated and we retry once with the exact size.
+        guess = int(self.counts().sum()) if len(self) else 0
+        out = np.empty(max(guess, 1), dtype=np.int32)
+        got = self._lib.ssn_encode(self._h, path.encode(), _ptr(out), out.size)
+        if got == -1:
+            # -1 is unambiguously an IO error: overflow returns -(total) and
+            # a 1-token corpus always fits the >=1-sized buffer
+            raise OSError(f"cannot read {path}")
+        if got < 0:
+            needed = -got
+            out = np.empty(needed, dtype=np.int32)
+            got = self._lib.ssn_encode(self._h, path.encode(), _ptr(out), needed)
+            if got < 0:
+                raise RuntimeError("corpus changed size during encode")
+        return out[:got]
+
+    def to_python(self):
+        from swiftsnails_tpu.data.vocab import Vocab
+
+        return Vocab(self.words(), self.counts())
+
+    def close(self):
+        if self._h:
+            self._lib.ssn_vocab_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def skipgram_pairs(
+    ids: np.ndarray, window: int, seed: int = 0, dynamic: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    lib = _require()
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    n = lib.ssn_skipgram_pairs(_ptr(ids), ids.size, window, seed, int(dynamic), None, None, 0)
+    centers = np.empty(n, dtype=np.int32)
+    contexts = np.empty(n, dtype=np.int32)
+    got = lib.ssn_skipgram_pairs(
+        _ptr(ids), ids.size, window, seed, int(dynamic), _ptr(centers), _ptr(contexts), n
+    )
+    assert got == n, (got, n)
+    return centers, contexts
+
+
+def subsample(
+    ids: np.ndarray, counts: np.ndarray, threshold: float, seed: int = 0
+) -> np.ndarray:
+    lib = _require()
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    out = np.empty(ids.size, dtype=np.int32)
+    k = lib.ssn_subsample(
+        _ptr(ids), ids.size, _ptr(counts), counts.size,
+        float(counts.sum()), threshold, seed, _ptr(out),
+    )
+    return out[:k]
+
+
+def read_ctr(path: str, num_fields: int) -> Tuple[np.ndarray, np.ndarray]:
+    lib = _require()
+    n = lib.ssn_read_ctr(path.encode(), num_fields, None, None, 0)
+    if n < 0:
+        raise OSError(f"cannot read {path}")
+    labels = np.empty(n, dtype=np.float32)
+    feats = np.empty((n, num_fields), dtype=np.int32)
+    got = lib.ssn_read_ctr(path.encode(), num_fields, _ptr(labels), _ptr(feats), n)
+    if got < 0:
+        raise RuntimeError("file changed size during read")
+    return labels[:got], feats[:got]
+
+
+class PairPrefetcher:
+    """Bounded-queue shuffled batch producer (queue_with_capacity parity).
+
+    A C++ producer thread shuffles and slices (centers, contexts) into
+    fixed-size batches; iteration blocks on the bounded queue and ends when
+    the producer finishes all epochs (poison-free close semantics).
+    """
+
+    def __init__(
+        self,
+        centers: np.ndarray,
+        contexts: np.ndarray,
+        batch_size: int,
+        epochs: int = 1,
+        capacity: int = 8,
+        seed: int = 0,
+    ):
+        lib = _require()
+        self._lib = lib
+        self.batch_size = batch_size
+        c = np.ascontiguousarray(centers, dtype=np.int32)
+        x = np.ascontiguousarray(contexts, dtype=np.int32)
+        self._h = lib.ssn_prefetch_open(
+            _ptr(c), _ptr(x), c.size, batch_size, epochs, capacity, seed
+        )
+        if not self._h:
+            raise ValueError("bad prefetcher arguments (empty data or batch > n)")
+
+    def __iter__(self):
+        while True:
+            centers = np.empty(self.batch_size, dtype=np.int32)
+            contexts = np.empty(self.batch_size, dtype=np.int32)
+            ok = self._lib.ssn_prefetch_next(self._h, _ptr(centers), _ptr(contexts))
+            if not ok:
+                return
+            yield {"centers": centers, "contexts": contexts}
+
+    def close(self):
+        if self._h:
+            self._lib.ssn_prefetch_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+

@@ -1,0 +1,361 @@
+// libsnails — native data-pipeline core for swiftsnails_tpu.
+//
+// TPU-native re-implementation of the reference's host-side hot path
+// (C++11 header-only utils, survey §2.1):
+//   * LineFileReader / scan_file_by_line (src/utils/string.h, file.h:11-33)
+//       -> buffered whole-file tokenizer (vocab_build / encode)
+//   * TextBuffer::get_math number parsing (src/utils/Buffer.h:240-324)
+//       -> strtol-at-cursor CTR record parser (read_ctr)
+//   * google dense_hash_map vocab (src/utils/hashmap.h)
+//       -> std::unordered_map with reserved buckets
+//   * queue_with_capacity bounded queue + poison-value shutdown
+//       (src/utils/queue.h:100-108) -> Prefetcher ring (mutex+condvar,
+//       producer thread, explicit close)
+//   * MurmurHash3 finalizer (src/utils/HashFunction.h:17-25) -> murmur64
+//
+// Exposed as a plain C ABI for ctypes (no pybind11). All buffers are
+// caller-owned numpy allocations unless documented otherwise.
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------- murmur ---
+
+// Exact HashFunction.h:17-25 finalizer.
+static inline uint64_t fmix64(uint64_t x) {
+  x ^= x >> 33;
+  x *= 0xff51afd7ed558ccdULL;
+  x ^= x >> 33;
+  x *= 0xc4ceb9fe1a85ec53ULL;
+  x ^= x >> 33;
+  return x;
+}
+
+extern "C" void ssn_murmur64(const uint64_t* in, uint64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = fmix64(in[i]);
+}
+
+extern "C" void ssn_hash_row(const uint32_t* keys, int64_t n, uint64_t capacity,
+                  int64_t* rows) {
+  for (int64_t i = 0; i < n; ++i)
+    rows[i] = (int64_t)(fmix64((uint64_t)keys[i]) % capacity);
+}
+
+// ----------------------------------------------------------------- vocab ---
+
+struct Vocab {
+  std::vector<std::string> words;
+  std::vector<int64_t> counts;
+  std::unordered_map<std::string, int32_t> index;
+};
+
+static bool read_file(const char* path, std::string* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  out->resize((size_t)size);
+  size_t got = size ? std::fread(&(*out)[0], 1, (size_t)size, f) : 0;
+  std::fclose(f);
+  out->resize(got);
+  return true;
+}
+
+static inline bool is_space(char c) {
+  return c == ' ' || c == '\n' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
+
+// Tokenize `data` in place, calling fn(ptr, len) per token.
+template <typename Fn>
+static void for_tokens(const std::string& data, Fn fn) {
+  const char* p = data.data();
+  const char* end = p + data.size();
+  while (p < end) {
+    while (p < end && is_space(*p)) ++p;
+    const char* start = p;
+    while (p < end && !is_space(*p)) ++p;
+    if (p > start) fn(start, (size_t)(p - start));
+  }
+}
+
+extern "C" void* ssn_vocab_build(const char* path, int min_count, int max_size) {
+  std::string data;
+  if (!read_file(path, &data)) return nullptr;
+  std::unordered_map<std::string, int64_t> counter;
+  counter.reserve(1 << 20);
+  for_tokens(data, [&](const char* s, size_t len) {
+    counter[std::string(s, len)] += 1;
+  });
+  std::vector<std::pair<std::string, int64_t>> items;
+  items.reserve(counter.size());
+  for (auto& kv : counter)
+    if (kv.second >= min_count) items.emplace_back(kv.first, kv.second);
+  // rank by freq desc then lexicographic — identical to Vocab.build ordering
+  std::sort(items.begin(), items.end(), [](const auto& a, const auto& b) {
+    if (a.second != b.second) return a.second > b.second;
+    return a.first < b.first;
+  });
+  if (max_size > 0 && (int)items.size() > max_size) items.resize(max_size);
+  Vocab* v = new Vocab();
+  v->words.reserve(items.size());
+  v->counts.reserve(items.size());
+  v->index.reserve(items.size());
+  for (size_t i = 0; i < items.size(); ++i) {
+    v->words.push_back(items[i].first);
+    v->counts.push_back(items[i].second);
+    v->index.emplace(items[i].first, (int32_t)i);
+  }
+  return v;
+}
+
+extern "C" int64_t ssn_vocab_size(void* h) { return h ? (int64_t)((Vocab*)h)->words.size() : -1; }
+
+extern "C" void ssn_vocab_counts(void* h, int64_t* out) {
+  Vocab* v = (Vocab*)h;
+  std::memcpy(out, v->counts.data(), v->counts.size() * sizeof(int64_t));
+}
+
+extern "C" int ssn_vocab_word(void* h, int64_t idx, char* buf, int buflen) {
+  Vocab* v = (Vocab*)h;
+  if (idx < 0 || idx >= (int64_t)v->words.size()) return -1;
+  const std::string& w = v->words[(size_t)idx];
+  if ((int)w.size() + 1 > buflen) return -(int)w.size() - 1;
+  std::memcpy(buf, w.data(), w.size());
+  buf[w.size()] = 0;
+  return (int)w.size();
+}
+
+extern "C" void ssn_vocab_free(void* h) { delete (Vocab*)h; }
+
+// Encode corpus file -> int32 ids (OOV dropped). Returns count, or -needed if
+// `cap` too small (call once with cap=0 to size), or -1 on IO error.
+extern "C" int64_t ssn_encode(void* h, const char* path, int32_t* out, int64_t cap) {
+  Vocab* v = (Vocab*)h;
+  std::string data;
+  if (!read_file(path, &data)) return -1;
+  int64_t n = 0;
+  bool overflow = false;
+  for_tokens(data, [&](const char* s, size_t len) {
+    auto it = v->index.find(std::string(s, len));
+    if (it != v->index.end()) {
+      if (out && n < cap) out[n] = it->second;
+      else overflow = true;
+      ++n;
+    }
+  });
+  if (out && overflow) return -n;  // caller's buffer was too small
+  return n;
+}
+
+// ------------------------------------------------------------- skip-gram ---
+
+// splitmix64: deterministic, matches nothing external — seeds the pair RNG.
+static inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// Dynamic-window pair generation (word2vec b ~ U(1, window)).
+// Returns npairs; if out arrays are null, only counts.
+extern "C" int64_t ssn_skipgram_pairs(const int32_t* ids, int64_t n, int window,
+                           uint64_t seed, int dynamic, int32_t* centers,
+                           int32_t* contexts, int64_t cap) {
+  uint64_t s = seed ^ 0xdeadbeefcafef00dULL;
+  int64_t k = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int b = dynamic ? (int)(splitmix64(s) % (uint64_t)window) + 1 : window;
+    int64_t lo = i - b < 0 ? 0 : i - b;
+    int64_t hi = i + b >= n ? n - 1 : i + b;
+    for (int64_t j = lo; j <= hi; ++j) {
+      if (j == i) continue;
+      if (centers) {
+        if (k >= cap) return -k;  // undersized buffer
+        centers[k] = ids[i];
+        contexts[k] = ids[j];
+      }
+      ++k;
+    }
+  }
+  return k;
+}
+
+// Frequent-word subsampling: keep w with p = sqrt(t/f) + t/f (word2vec).
+// Writes kept ids to out, returns kept count.
+extern "C" int64_t ssn_subsample(const int32_t* ids, int64_t n, const int64_t* counts,
+                      int64_t vocab, double total, double threshold,
+                      uint64_t seed, int32_t* out) {
+  if (threshold <= 0) {
+    std::memcpy(out, ids, (size_t)n * sizeof(int32_t));
+    return n;
+  }
+  uint64_t s = seed ^ 0x12345678abcdefULL;
+  int64_t k = 0;
+  const double inv = 1.0 / 9007199254740992.0;  // 2^-53
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t id = ids[i];
+    double f = (id >= 0 && id < vocab ? (double)counts[id] : 1.0) / total;
+    double keep = std::min(1.0, std::sqrt(threshold / f) + threshold / f);
+    double u = (double)(splitmix64(s) >> 11) * inv;
+    if (u < keep) out[k++] = id;
+  }
+  return k;
+}
+
+// ------------------------------------------------------------------- ctr ---
+
+// Parse "label f0 f1 ..." lines (TextBuffer::get_math parity). PAD = -1.
+// Returns row count; sizes only when outputs are null.
+extern "C" int64_t ssn_read_ctr(const char* path, int num_fields, float* labels_out,
+                     int32_t* feats_out, int64_t max_rows) {
+  std::string data;
+  if (!read_file(path, &data)) return -1;
+  const char* p = data.data();
+  const char* end = p + data.size();
+  int64_t row = 0;
+  while (p < end) {
+    const char* line_end = (const char*)memchr(p, '\n', (size_t)(end - p));
+    if (!line_end) line_end = end;
+    // skip blank lines
+    const char* q = p;
+    while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+    if (q < line_end) {
+      char* next = nullptr;
+      double label = std::strtod(q, &next);
+      if (next != q) {
+        if (labels_out) {
+          if (row >= max_rows) return -row;
+          labels_out[row] = (float)label;
+          int32_t* feats = feats_out + (int64_t)row * num_fields;
+          for (int f = 0; f < num_fields; ++f) feats[f] = -1;
+          const char* cur = next;
+          for (int f = 0; f < num_fields && cur < line_end; ++f) {
+            while (cur < line_end && (*cur == ' ' || *cur == '\t')) ++cur;
+            if (cur >= line_end) break;
+            char* after = nullptr;
+            long v = std::strtol(cur, &after, 10);
+            if (after == cur) break;
+            // "field:id" form — take the id after ':'
+            if (after < line_end && *after == ':') {
+              cur = after + 1;
+              v = std::strtol(cur, &after, 10);
+              if (after == cur) break;
+            }
+            feats[f] = (int32_t)v;
+            cur = after;
+          }
+        }
+        ++row;
+      }
+    }
+    p = line_end + 1;
+  }
+  return row;
+}
+
+// -------------------------------------------------------------- prefetch ---
+
+// Bounded-queue shuffled-batch producer (queue_with_capacity parity:
+// capacity-bounded, blocking push/pop, explicit end_input poison).
+struct Prefetcher {
+  std::vector<int32_t> centers, contexts;
+  int64_t batch;
+  int epochs;
+  uint64_t seed;
+  size_t capacity;
+
+  std::deque<std::vector<int32_t>> queue;  // interleaved [c0,x0,c1,x1,...]
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  bool done = false, closed = false;
+  std::thread worker;
+
+  void produce() {
+    int64_t n = (int64_t)centers.size();
+    int64_t nb = n / batch;
+    std::vector<int64_t> order((size_t)n);
+    std::mt19937_64 rng(seed);
+    for (int e = 0; e < epochs; ++e) {
+      for (int64_t i = 0; i < n; ++i) order[(size_t)i] = i;
+      std::shuffle(order.begin(), order.end(), rng);
+      for (int64_t bi = 0; bi < nb; ++bi) {
+        std::vector<int32_t> item((size_t)(2 * batch));
+        for (int64_t j = 0; j < batch; ++j) {
+          int64_t src = order[(size_t)(bi * batch + j)];
+          item[(size_t)(2 * j)] = centers[(size_t)src];
+          item[(size_t)(2 * j + 1)] = contexts[(size_t)src];
+        }
+        std::unique_lock<std::mutex> lk(mu);
+        cv_push.wait(lk, [&] { return queue.size() < capacity || closed; });
+        if (closed) return;
+        queue.push_back(std::move(item));
+        cv_pop.notify_one();
+      }
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    done = true;
+    cv_pop.notify_all();
+  }
+};
+
+extern "C" void* ssn_prefetch_open(const int32_t* centers, const int32_t* contexts,
+                        int64_t n, int64_t batch, int epochs, int capacity,
+                        uint64_t seed) {
+  if (n <= 0 || batch <= 0 || batch > n) return nullptr;
+  Prefetcher* p = new Prefetcher();
+  p->centers.assign(centers, centers + n);
+  p->contexts.assign(contexts, contexts + n);
+  p->batch = batch;
+  p->epochs = epochs;
+  p->seed = seed;
+  p->capacity = (size_t)(capacity > 0 ? capacity : 4);
+  p->worker = std::thread([p] { p->produce(); });
+  return p;
+}
+
+// 1 = batch written; 0 = end of input (reference poison value semantics).
+extern "C" int ssn_prefetch_next(void* h, int32_t* centers_out, int32_t* contexts_out) {
+  Prefetcher* p = (Prefetcher*)h;
+  std::vector<int32_t> item;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv_pop.wait(lk, [&] { return !p->queue.empty() || p->done; });
+    if (p->queue.empty()) return 0;
+    item = std::move(p->queue.front());
+    p->queue.pop_front();
+    p->cv_push.notify_one();
+  }
+  for (int64_t j = 0; j < p->batch; ++j) {
+    centers_out[j] = item[(size_t)(2 * j)];
+    contexts_out[j] = item[(size_t)(2 * j + 1)];
+  }
+  return 1;
+}
+
+extern "C" void ssn_prefetch_close(void* h) {
+  Prefetcher* p = (Prefetcher*)h;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->closed = true;
+    p->cv_push.notify_all();
+    p->cv_pop.notify_all();
+  }
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+

@@ -16,10 +16,18 @@ from swiftsnails_tpu.data.vocab import Vocab
 
 
 def read_tokens(path: str, limit_bytes: Optional[int] = None) -> List[str]:
-    """Whitespace-tokenize a corpus file (text8-style: one giant line is fine)."""
-    with open(path, "r", encoding="utf-8", errors="replace") as f:
+    """Whitespace-tokenize a corpus file (text8-style: one giant line is fine).
+
+    Splits at the *byte* level on ASCII whitespace, then decodes each token
+    (errors='replace') — exactly the native tokenizer's behavior
+    (``libsnails.cpp`` ``for_tokens``), so the two paths produce identical
+    token streams for any UTF-8-clean corpus. (Residual edge: two distinct
+    invalid-UTF-8 byte tokens can decode to the same replacement string here
+    while remaining distinct in the byte-keyed native vocab.)
+    """
+    with open(path, "rb") as f:
         data = f.read(limit_bytes) if limit_bytes else f.read()
-    return data.split()
+    return [t.decode("utf-8", "replace") for t in data.split()]
 
 
 def encode_corpus(
@@ -28,8 +36,24 @@ def encode_corpus(
     max_vocab: Optional[int] = None,
     limit_bytes: Optional[int] = None,
     vocab: Optional[Vocab] = None,
+    use_native: Optional[bool] = None,
 ) -> Tuple[np.ndarray, Vocab]:
-    """Read, build (or reuse) a vocab, and encode to an int32 id stream."""
+    """Read, build (or reuse) a vocab, and encode to an int32 id stream.
+
+    Prefers the C++ pipeline (tokenize + count + encode in one pass) when the
+    toolchain is available and no byte limit / preexisting vocab forces the
+    Python path; results are identical (tested).
+    """
+    from swiftsnails_tpu.data import native
+
+    if use_native is None:
+        use_native = vocab is None and limit_bytes is None and native.available()
+    if use_native and vocab is None and limit_bytes is None:
+        nv = native.NativeVocab(path, min_count=min_count, max_size=max_vocab or 0)
+        ids = nv.encode_file(path)
+        py_vocab = nv.to_python()
+        nv.close()
+        return ids, py_vocab
     tokens = read_tokens(path, limit_bytes=limit_bytes)
     if vocab is None:
         vocab = Vocab.build(tokens, min_count=min_count, max_size=max_vocab)

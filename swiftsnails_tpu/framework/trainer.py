@@ -92,10 +92,15 @@ class TrainLoop:
     ):
         self.trainer = trainer
         self.metrics = metrics or MetricsLogger(echo=False)
-        self.checkpoint_fn = checkpoint_fn
         self.log_every = log_every
         cfg = trainer.config
         self.backup_period = cfg.get_int("param_backup_period", 0)
+        self.backup_root = cfg.get_str("param_backup_root", "")
+        if checkpoint_fn is None and self.backup_root:
+            from swiftsnails_tpu.framework.checkpoint import save_checkpoint
+
+            checkpoint_fn = lambda state, step: save_checkpoint(self.backup_root, state, step)
+        self.checkpoint_fn = checkpoint_fn
         self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
@@ -108,8 +113,17 @@ class TrainLoop:
     def run(self, seed: int = 0, max_steps: Optional[int] = None) -> Any:
         trainer = self.trainer
         state = trainer.init_state()
-        root_rng = jax.random.PRNGKey(seed)
         step = 0
+        if trainer.config.get_bool("resume", False) and self.backup_root:
+            from swiftsnails_tpu.framework.checkpoint import latest_step, restore_checkpoint
+
+            restored_step = latest_step(self.backup_root)
+            if restored_step is not None:
+                state = restore_checkpoint(self.backup_root, state, step=restored_step)
+                # continue the step counter so later checkpoints advance
+                # monotonically and the per-step RNG stream doesn't replay
+                step = restored_step
+        root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
         for batch in trainer.batches():
             n_items = trainer.items_per_batch(batch)

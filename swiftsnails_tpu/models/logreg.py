@@ -1,0 +1,27 @@
+"""Sparse logistic regression (the reference's second app, survey §2.7:
+``src/apps/logistic_regression`` — key = feature id, Val = float weight,
+Grad = float, SGD; the BASELINE.json Criteo-1M config)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.models.registry import register_model
+from swiftsnails_tpu.models.sparse_base import SparseCTRTrainer
+
+
+@register_model("logreg")
+class LogisticRegressionTrainer(SparseCTRTrainer):
+    name = "logreg"
+
+    @property
+    def table_dim(self) -> int:
+        return 1
+
+    def init_dense(self, rng):
+        return {"bias": jnp.zeros(())}
+
+    def forward(self, pulled, dense, mask):
+        w = pulled[..., 0]  # [B, F]
+        return jnp.where(mask, w, 0).sum(axis=1) + dense["bias"]

@@ -1,0 +1,173 @@
+"""Shared machinery for the sparse CTR model families (LR, FM, FFM, W&D).
+
+Each model is a :class:`~swiftsnails_tpu.framework.trainer.Trainer` over one
+hashed parameter table (the reference's ``SparseTable`` with app-specific
+``Val``/``Grad`` types, survey §2.7) plus an optional *dense* pytree (MLP
+weights for Wide&Deep) trained with optax. The sparse side keeps the
+pull -> grad-w.r.t.-pulled-rows -> push contract; padding fields (``PAD=-1``)
+are masked out of both the forward pass and the pushed gradients.
+
+Config keys: ``num_fields``, ``capacity``, ``learning_rate``, ``optimizer``
+(``sgd`` | ``adagrad``), ``batch_size``, ``num_iters``, ``data``,
+``dense_learning_rate``, ``seed``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from swiftsnails_tpu.data.ctr import ctr_batches, read_ctr_file
+from swiftsnails_tpu.framework.trainer import Trainer
+from swiftsnails_tpu.models.registry import register_model  # noqa: F401 (re-export)
+from swiftsnails_tpu.ops.hashing import hash_row
+from swiftsnails_tpu.parallel.access import AdaGradAccess, SgdAccess
+from swiftsnails_tpu.parallel.store import TableState, create_table, pull, push
+from swiftsnails_tpu.utils.config import Config
+
+
+class CTRState(NamedTuple):
+    table: TableState
+    dense: Any  # dense param pytree ({} when the model has none)
+    opt: Any  # optax state for the dense side
+
+
+def bce_with_logits(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Numerically-stable binary cross-entropy on logits."""
+    return jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+
+
+def auc_score(labels: np.ndarray, scores: np.ndarray) -> float:
+    """Rank-based AUC (Mann-Whitney), host-side eval."""
+    order = np.argsort(scores)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels > 0.5
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return float((ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+class SparseCTRTrainer(Trainer):
+    """Base: one hashed table + optional dense pytree. Subclasses define
+    ``table_dim``, ``forward(pulled, dense, mask)`` and optionally
+    ``init_dense``."""
+
+    def __init__(
+        self,
+        config: Config,
+        mesh=None,
+        data: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+    ):
+        super().__init__(config, mesh)
+        cfg = config
+        self.num_fields = cfg.get_int("num_fields")
+        self.capacity = cfg.get_int("capacity", 1 << 20)
+        self.lr = cfg.get_float("learning_rate", 0.05)
+        self.dense_lr = cfg.get_float("dense_learning_rate", self.lr)
+        self.epochs = cfg.get_int("num_iters", 1)
+        self.batch_size = cfg.get_int("batch_size", 1024)
+        self.seed = cfg.get_int("seed", 0)
+        opt_name = cfg.get_str("optimizer", "adagrad")
+        self.access = {"sgd": SgdAccess(), "adagrad": AdaGradAccess()}[opt_name]
+        self.dense_opt = (
+            optax.adagrad(self.dense_lr) if opt_name == "adagrad" else optax.sgd(self.dense_lr)
+        )
+        if data is not None:
+            self.labels, self.feats = data
+        else:
+            from swiftsnails_tpu.data import native
+
+            if cfg.get_bool("use_native", True) and native.available():
+                self.labels, self.feats = native.read_ctr(
+                    cfg.get_str("data"), self.num_fields
+                )
+            else:
+                self.labels, self.feats = read_ctr_file(
+                    cfg.get_str("data"), self.num_fields
+                )
+
+    # -- subclass API ------------------------------------------------------
+
+    @property
+    def table_dim(self) -> int:
+        raise NotImplementedError
+
+    def forward(self, pulled: jax.Array, dense: Any, mask: jax.Array) -> jax.Array:
+        """(pulled [B,F,dim], dense pytree, mask [B,F]) -> logits [B]."""
+        raise NotImplementedError
+
+    def init_dense(self, rng: jax.Array) -> Any:
+        return {}
+
+    # -- framework ---------------------------------------------------------
+
+    def init_state(self) -> CTRState:
+        table = create_table(
+            self.capacity, self.table_dim, self.access, mesh=self.mesh,
+            seed=self.seed, init_scale=self.config.get_float("init_scale", 1.0),
+        )
+        dense = self.init_dense(jax.random.PRNGKey(self.seed + 17))
+        opt = self.dense_opt.init(dense)
+        return CTRState(table=table, dense=dense, opt=opt)
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.default_rng(self.seed)
+        yield from ctr_batches(
+            self.labels, self.feats, self.batch_size, rng, epochs=self.epochs
+        )
+
+    def _rows(self, feats: jax.Array) -> jax.Array:
+        safe = jnp.maximum(feats, 0)
+        return hash_row(safe, self.capacity)
+
+    def train_step(self, state: CTRState, batch, rng):
+        feats, labels = batch["feats"], batch["labels"]
+        b, f = feats.shape
+        mask = feats >= 0
+        rows = self._rows(feats).reshape(-1)
+        pulled = pull(state.table, rows).reshape(b, f, self.table_dim)
+
+        def loss_of(pulled, dense):
+            logits = self.forward(pulled, dense, mask)
+            loss = bce_with_logits(logits, labels).mean()
+            return loss, logits
+
+        (loss, logits), (dp, dd) = jax.value_and_grad(
+            loss_of, argnums=(0, 1), has_aux=True
+        )(pulled, state.dense)
+        dp = jnp.where(mask[..., None], dp, 0)  # no pushes from padding
+        table = push(state.table, rows, dp.reshape(-1, self.table_dim), self.access, self.lr)
+        if state.dense:
+            updates, opt = self.dense_opt.update(dd, state.opt, state.dense)
+            dense = optax.apply_updates(state.dense, updates)
+        else:
+            dense, opt = state.dense, state.opt
+        acc = ((logits > 0) == (labels > 0.5)).mean()
+        return CTRState(table, dense, opt), {"loss": loss, "accuracy": acc}
+
+    # -- eval --------------------------------------------------------------
+
+    def predict(self, state: CTRState, feats: np.ndarray) -> np.ndarray:
+        feats = jnp.asarray(feats)
+        mask = feats >= 0
+        b, f = feats.shape
+        rows = self._rows(feats).reshape(-1)
+        pulled = pull(state.table, rows).reshape(b, f, self.table_dim)
+        return np.asarray(self.forward(pulled, state.dense, mask))
+
+    def eval_auc(self, state: CTRState, labels=None, feats=None, limit: int = 20000) -> float:
+        labels = self.labels[:limit] if labels is None else labels
+        feats = self.feats[:limit] if feats is None else feats
+        return auc_score(labels, self.predict(state, feats))
+
+    def export_text(self, state: CTRState, path: str) -> None:
+        from swiftsnails_tpu.framework.checkpoint import export_table_text
+
+        export_table_text(state.table.table, path)

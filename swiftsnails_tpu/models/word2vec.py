@@ -69,6 +69,10 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+from swiftsnails_tpu.models.registry import register_model
+
+
+@register_model("word2vec")
 class Word2VecTrainer(Trainer):
     name = "word2vec"
 
@@ -132,15 +136,26 @@ class Word2VecTrainer(Trainer):
     # -- data --------------------------------------------------------------
 
     def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        from swiftsnails_tpu.data import native
+
+        use_native = self.config.get_bool("use_native", True) and native.available()
         rng = np.random.default_rng(self.seed)
         counts = self.vocab.counts
-        for _ in range(self.epochs):
+        for epoch in range(self.epochs):
             ids = self.corpus_ids
             for start in range(0, len(ids), self.chunk_tokens):
                 chunk = ids[start : start + self.chunk_tokens]
-                if self.subsample > 0:
-                    chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
-                centers, contexts = skipgram_pairs(chunk, self.window, rng)
+                seed = (self.seed * 1_000_003 + epoch * 7919 + start) & 0xFFFFFFFF
+                if use_native:
+                    if self.subsample > 0:
+                        chunk = native.subsample(chunk, counts, self.subsample, seed=seed)
+                    centers, contexts = native.skipgram_pairs(
+                        chunk, self.window, seed=seed
+                    )
+                else:
+                    if self.subsample > 0:
+                        chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
+                    centers, contexts = skipgram_pairs(chunk, self.window, rng)
                 yield from batch_stream(centers, contexts, self.batch_size, rng)
 
     # -- step --------------------------------------------------------------

@@ -1,0 +1,125 @@
+"""CTR model families: convergence on synthetic planted-weight data
+(golden-value strategy, survey §4), sharded + single-device, plus record
+parsing parity."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.data.ctr import PAD, ctr_batches, parse_record, read_ctr_file, synth_ctr
+from swiftsnails_tpu.framework.trainer import TrainLoop
+from swiftsnails_tpu.models.registry import available_models, get_model
+from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+from swiftsnails_tpu.utils.config import Config
+
+NUM_FIELDS = 6
+VOCAB_PER_FIELD = 50
+
+
+def make_cfg(**overrides):
+    cfg = Config(
+        {
+            "num_fields": str(NUM_FIELDS),
+            "capacity": str(1 << 14),
+            "learning_rate": "0.2",
+            "optimizer": "adagrad",
+            "batch_size": "512",
+            "num_iters": "4",
+            "seed": "0",
+        }
+    )
+    for k, v in overrides.items():
+        cfg.set(k, v)
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def data():
+    return synth_ctr(12000, NUM_FIELDS, VOCAB_PER_FIELD, seed=3)
+
+
+def run_model(name, data, mesh=None, **overrides):
+    labels, feats, _ = data
+    cls = get_model(name)
+    trainer = cls(make_cfg(**overrides), mesh=mesh, data=(labels, feats))
+    loop = TrainLoop(trainer, log_every=0)
+    state = loop.run()
+    return trainer, state
+
+
+def test_registry_has_all_families():
+    names = available_models()
+    for expected in ("word2vec", "logreg", "fm", "ffm", "widedeep"):
+        assert expected in names, f"{expected} missing from registry {names}"
+
+
+@pytest.mark.parametrize("name", ["logreg", "fm", "ffm", "widedeep"])
+def test_model_learns(name, data):
+    trainer, state = run_model(name, data)
+    auc = trainer.eval_auc(state, limit=4000)
+    assert auc > 0.80, f"{name}: AUC {auc:.3f} too low"
+
+
+def test_logreg_sharded_matches_quality(data):
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+    trainer, state = run_model("logreg", data, mesh=mesh)
+    auc = trainer.eval_auc(state, limit=4000)
+    assert auc > 0.80, f"sharded logreg AUC {auc:.3f}"
+
+
+def test_fm_captures_interactions():
+    """FM must beat LR on data with planted pairwise interactions."""
+    data_i = synth_ctr(12000, 4, 30, seed=5, interaction=True, noise=0.1)
+    tr_lr, st_lr = run_model("logreg", data_i, num_fields="4", num_iters="6")
+    tr_fm, st_fm = run_model("fm", data_i, num_fields="4", num_iters="6", factor_dim="8")
+    auc_lr = tr_lr.eval_auc(st_lr, limit=4000)
+    auc_fm = tr_fm.eval_auc(st_fm, limit=4000)
+    assert auc_fm > auc_lr + 0.02, f"FM {auc_fm:.3f} should beat LR {auc_lr:.3f}"
+
+
+def test_padding_fields_ignored(data):
+    """Records with PAD fields must produce identical logits to unpadded."""
+    labels, feats, _ = data
+    trainer, state = run_model("logreg", data, num_iters="1")
+    full = trainer.predict(state, feats[:64])
+    padded = feats[:64].copy()
+    padded[:, -2:] = PAD
+    manual = feats[:64].copy()
+    # prediction with padding == prediction summing only non-pad fields
+    got = trainer.predict(state, padded)
+    want = trainer.predict(state, np.concatenate(
+        [manual[:, :-2], np.full((64, 2), PAD, np.int32)], axis=1))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert not np.allclose(got, full)  # dropping fields changes the logit
+
+
+def test_parse_record_and_file(tmp_path):
+    lab, feats = parse_record("1 3 17 29", num_fields=4)
+    assert lab == 1.0
+    np.testing.assert_array_equal(feats, [3, 17, 29, PAD])
+    lab2, feats2 = parse_record("0 0:5 1:9", num_fields=2)
+    np.testing.assert_array_equal(feats2, [5, 9])
+
+    p = tmp_path / "ctr.txt"
+    p.write_text("1 1 2\n0 3 4\n\n1 5\n")
+    labels, rows = read_ctr_file(str(p), num_fields=2)
+    np.testing.assert_array_equal(labels, [1, 0, 1])
+    np.testing.assert_array_equal(rows, [[1, 2], [3, 4], [5, PAD]])
+
+
+def test_parse_malformed_matches_native_semantics(tmp_path):
+    """Header rows skip; bad feature tokens stop the row; both paths agree."""
+    content = "label f0 f1\n1 3 x\n0 7:bad 9\n1 2 8\n"
+    p = tmp_path / "m.txt"
+    p.write_text(content)
+    labels, rows = read_ctr_file(str(p), num_fields=2)
+    np.testing.assert_array_equal(labels, [1, 0, 1])
+    np.testing.assert_array_equal(rows, [[3, PAD], [PAD, PAD], [2, 8]])
+    from swiftsnails_tpu.data import native
+
+    if native.available():
+        nl, nf = native.read_ctr(str(p), num_fields=2)
+        np.testing.assert_array_equal(nl, labels)
+        np.testing.assert_array_equal(nf, rows)

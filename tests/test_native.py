@@ -1,0 +1,108 @@
+"""Native C++ pipeline vs pure-Python reference implementations."""
+
+import numpy as np
+import pytest
+
+from swiftsnails_tpu.data import native
+from swiftsnails_tpu.data.sampler import skipgram_pairs as py_pairs
+from swiftsnails_tpu.data.vocab import Vocab
+from swiftsnails_tpu.ops.hashing import hash_row_np, murmur_fmix64_np
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native build failed: {native.build_error()}"
+)
+
+
+def test_murmur_matches_python():
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 1 << 64, size=4096, dtype=np.uint64)
+    np.testing.assert_array_equal(native.murmur64(xs), murmur_fmix64_np(xs))
+
+
+def test_hash_row_matches_python():
+    rng = np.random.default_rng(1)
+    keys = rng.integers(0, 1 << 32, size=4096, dtype=np.uint32)
+    np.testing.assert_array_equal(
+        native.hash_row(keys, 1 << 20), hash_row_np(keys, 1 << 20)
+    )
+
+
+def test_vocab_matches_python(tmp_path):
+    text = "the cat sat on the mat the cat ran\n" * 7
+    p = tmp_path / "c.txt"
+    p.write_text(text)
+    nv = native.NativeVocab(str(p), min_count=2)
+    pv = Vocab.build(text.split(), min_count=2)
+    assert nv.words() == pv.words
+    np.testing.assert_array_equal(nv.counts(), pv.counts)
+    ids = nv.encode_file(str(p))
+    np.testing.assert_array_equal(ids, pv.encode(text.split()))
+    nv.close()
+
+
+def test_skipgram_pairs_full_window_matches_python():
+    ids = np.arange(50, dtype=np.int32)
+    c_native, x_native = native.skipgram_pairs(ids, window=3, dynamic=False)
+    c_py, x_py = py_pairs(ids, window=3, rng=np.random.default_rng(0), dynamic=False)
+    # same pair multiset (orders differ)
+    got = sorted(zip(c_native.tolist(), x_native.tolist()))
+    want = sorted(zip(c_py.tolist(), x_py.tolist()))
+    assert got == want
+
+
+def test_skipgram_dynamic_within_bounds():
+    ids = np.arange(200, dtype=np.int32)
+    c, x = native.skipgram_pairs(ids, window=5, seed=7, dynamic=True)
+    assert len(c) == len(x) > 0
+    assert np.all(np.abs(c - x) <= 5)
+    assert np.all(c != x)
+    # deterministic per seed
+    c2, x2 = native.skipgram_pairs(ids, window=5, seed=7, dynamic=True)
+    np.testing.assert_array_equal(c, c2)
+
+
+def test_subsample_keeps_rare():
+    counts = np.array([1_000_000, 10], dtype=np.int64)
+    ids = np.array([0] * 1000 + [1] * 1000, dtype=np.int32)
+    kept = native.subsample(ids, counts, threshold=1e-4, seed=1)
+    assert np.all(np.isin(kept, [0, 1]))
+    assert (kept == 1).sum() == 1000  # rare word always kept
+    assert (kept == 0).sum() < 500
+
+
+def test_read_ctr_matches_python(tmp_path):
+    from swiftsnails_tpu.data.ctr import read_ctr_file
+
+    p = tmp_path / "ctr.txt"
+    p.write_text("1 3 17 29\n0 0:5 1:9\n\n1 7\n")
+    nl, nf = native.read_ctr(str(p), num_fields=4)
+    pl, pf = read_ctr_file(str(p), num_fields=4)
+    np.testing.assert_array_equal(nl, pl)
+    np.testing.assert_array_equal(nf, pf)
+
+
+def test_prefetcher_delivers_all_pairs():
+    n = 1000
+    centers = np.arange(n, dtype=np.int32)
+    contexts = np.arange(n, dtype=np.int32) + 10_000
+    pf = native.PairPrefetcher(centers, contexts, batch_size=100, epochs=2, seed=3)
+    batches = list(pf)
+    pf.close()
+    assert len(batches) == 20  # 10 per epoch x 2
+    for b in batches:
+        np.testing.assert_array_equal(b["contexts"] - b["centers"], 10_000)
+    seen = np.sort(np.concatenate([b["centers"] for b in batches[:10]]))
+    np.testing.assert_array_equal(seen, centers)  # epoch = full permutation
+
+
+def test_prefetcher_early_close_no_hang():
+    pf = native.PairPrefetcher(
+        np.arange(10_000, dtype=np.int32),
+        np.arange(10_000, dtype=np.int32),
+        batch_size=64,
+        epochs=100,
+        capacity=2,
+    )
+    it = iter(pf)
+    next(it)
+    pf.close()  # producer blocked on full queue must exit cleanly
