@@ -100,7 +100,7 @@ def export_table_text(table: jax.Array, path_or_file, keys: Optional[np.ndarray]
             keys = np.arange(n, dtype=np.int64)
         for start in range(0, n, chunk_rows):
             stop = min(start + chunk_rows, n)
-            block = np.asarray(table[start:stop])
+            block = np.asarray(table[start:stop], dtype=np.float32)
             for i, row in enumerate(block):
                 vals = " ".join(f"{x:.6f}" for x in row)
                 f.write(f"{int(keys[start + i])}\t{vals}\n")

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from swiftsnails_tpu.utils.config import Config
 from swiftsnails_tpu.utils.metrics import MetricsLogger
+from swiftsnails_tpu.utils.profiling import StepProfiler, step_annotation
 from swiftsnails_tpu.parallel.mesh import DATA_AXIS, batch_sharding
 
 
@@ -101,6 +102,7 @@ class TrainLoop:
 
             checkpoint_fn = lambda state, step: save_checkpoint(self.backup_root, state, step)
         self.checkpoint_fn = checkpoint_fn
+        self.profiler = StepProfiler(cfg)
         self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
@@ -125,20 +127,26 @@ class TrainLoop:
                 step = restored_step
         root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
-        for batch in trainer.batches():
-            n_items = trainer.items_per_batch(batch)
-            dev_batch = self._device_batch(batch)
-            rng = jax.random.fold_in(root_rng, step)
-            state, last_metrics = self._step_fn(state, dev_batch, rng)
-            step += 1
-            self.metrics.count(n_items)
-            if self.log_every and step % self.log_every == 0:
-                host = {k: float(v) for k, v in last_metrics.items()}
-                self.metrics.flush_window(step=step, **host)
-            if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
-                self.checkpoint_fn(state, step)
-            if max_steps is not None and step >= max_steps:
-                break
+        try:
+            for batch in trainer.batches():
+                n_items = trainer.items_per_batch(batch)
+                self.profiler.on_step(step)
+                with step_annotation(trainer.name, step):
+                    dev_batch = self._device_batch(batch)
+                    rng = jax.random.fold_in(root_rng, step)
+                    state, last_metrics = self._step_fn(state, dev_batch, rng)
+                step += 1
+                self.metrics.count(n_items)
+                if self.log_every and step % self.log_every == 0:
+                    host = {k: float(v) for k, v in last_metrics.items()}
+                    self.metrics.flush_window(step=step, **host)
+                if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
+                    self.checkpoint_fn(state, step)
+                if max_steps is not None and step >= max_steps:
+                    break
+        finally:
+            # an open trace must be finalized even on error/interrupt
+            self.profiler.close()
         # block so throughput/final metrics are real, then final flush
         jax.block_until_ready(jax.tree_util.tree_leaves(state))
         if step % max(self.log_every, 1) != 0 or not self.log_every:

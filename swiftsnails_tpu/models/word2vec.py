@@ -56,9 +56,13 @@ def sgns_loss(v: jax.Array, u_pos: jax.Array, u_neg: jax.Array) -> jax.Array:
     ``v``: [B, D] center rows; ``u_pos``: [B, D] context rows;
     ``u_neg``: [B, K, D] negative rows. Mean over batch of
     ``-log σ(v·u_pos) - Σ_k log σ(-v·u_neg_k)``.
+
+    With bf16 tables the dot products accumulate in f32
+    (``preferred_element_type``) and all loss math past the logits is f32, so
+    only the row storage/bandwidth is reduced precision.
     """
-    pos = jnp.sum(v * u_pos, axis=-1)
-    neg = jnp.einsum("bd,bkd->bk", v, u_neg)
+    pos = jnp.einsum("bd,bd->b", v, u_pos, preferred_element_type=jnp.float32)
+    neg = jnp.einsum("bd,bkd->bk", v, u_neg, preferred_element_type=jnp.float32)
     return -(jax.nn.log_sigmoid(pos) + jax.nn.log_sigmoid(-neg).sum(axis=-1)).mean()
 
 
@@ -95,6 +99,9 @@ class Word2VecTrainer(Trainer):
         self.hash_keys = cfg.get_bool("hash_keys", False)
         self.chunk_tokens = cfg.get_int("chunk_tokens", 1 << 20)
         self.seed = cfg.get_int("seed", 0)
+        self.table_dtype = {
+            "float32": jnp.float32, "bfloat16": jnp.bfloat16,
+        }[cfg.get_str("table_dtype", "float32")]
 
         if corpus_ids is None:
             data_path = cfg.get_str("data")
@@ -119,12 +126,13 @@ class Word2VecTrainer(Trainer):
 
     def init_state(self) -> W2VState:
         in_table = create_table(
-            self.capacity, self.dim, self.access, mesh=self.mesh, seed=self.seed
+            self.capacity, self.dim, self.access, mesh=self.mesh, seed=self.seed,
+            dtype=self.table_dtype,
         )
         # reference word2vec inits syn1neg to zeros; init_scale=0 keeps that
         out_table = create_table(
             self.capacity, self.dim, self.access, mesh=self.mesh,
-            seed=self.seed + 1, init_scale=0.0,
+            seed=self.seed + 1, init_scale=0.0, dtype=self.table_dtype,
         )
         return W2VState(in_table=in_table, out_table=out_table)
 
@@ -183,7 +191,8 @@ class Word2VecTrainer(Trainer):
 
     def export_text(self, state: W2VState, path: str) -> None:
         rows = np.asarray(
-            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32)))
+            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32))),
+            dtype=np.float32,  # bf16 tables: ml_dtypes scalars don't format
         )
         with open(path, "w", encoding="utf-8") as f:
             f.write(f"{len(self.vocab)} {self.dim}\n")
@@ -195,7 +204,8 @@ class Word2VecTrainer(Trainer):
 
     def neighbors(self, state: W2VState, word: str, topn: int = 10):
         emb = np.asarray(
-            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32)))
+            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32))),
+            dtype=np.float32,
         )
         norms = np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9
         emb = emb / norms

@@ -19,7 +19,7 @@ alongside params in the sharded pytree").
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,12 +59,33 @@ class AccessMethod:
         """
         raise NotImplementedError
 
+    def scatter_update(
+        self, table: jax.Array, slots: Slots, rows: jax.Array, grads: jax.Array, lr
+    ) -> Optional[Tuple[jax.Array, Slots]]:
+        """Sort-free duplicate-safe update, or None if only the exact
+        merge-then-apply path is valid.
+
+        The exact path (``merge_duplicate_rows`` + ``apply_push_value``)
+        argsorts the batch's rows every push — expensive on TPU. Linear rules
+        (SGD) are scatter-add-exact; AdaGrad uses the per-sample-accumulator
+        variant (``accum += Σ g_i²`` instead of ``(Σ g_i)²`` for duplicate
+        keys — standard in hogwild implementations, including effectively the
+        reference's own async workers racing on the same key across pushes).
+        Rows may contain out-of-range padding; all scatters use mode='drop'.
+        """
+        return None
+
 
 class SgdAccess(AccessMethod):
     """Plain SGD: ``param -= lr * grad``."""
 
     def apply_push_value(self, param, slots, grad, lr):
         return param - lr * grad.astype(param.dtype), slots
+
+    def scatter_update(self, table, slots, rows, grads, lr):
+        # scatter-add sums duplicate rows natively — identical math, no sort
+        table = table.at[rows].add(-(lr * grads).astype(table.dtype), mode="drop")
+        return table, slots
 
 
 class AdaGradAccess(AccessMethod):
@@ -87,3 +108,16 @@ class AdaGradAccess(AccessMethod):
         step = lr * g * jax.lax.rsqrt(accum + self.eps)
         new_param = param - step.astype(param.dtype)
         return new_param, {"accum": accum.astype(slots["accum"].dtype)}
+
+    def scatter_update(self, table, slots, rows, grads, lr):
+        # two-phase: (1) scatter-add per-sample g² into the accumulator,
+        # (2) gather the post-update accumulator (duplicates all see the
+        # final value — deterministic), scale, scatter-add the steps.
+        g = grads.astype(jnp.float32)
+        accum = slots["accum"].at[rows].add(
+            (g * g).astype(slots["accum"].dtype), mode="drop"
+        )
+        acc_rows = accum.at[rows].get(mode="fill", fill_value=1.0).astype(jnp.float32)
+        step = lr * g * jax.lax.rsqrt(acc_rows + self.eps)
+        table = table.at[rows].add(-step.astype(table.dtype), mode="drop")
+        return table, {"accum": accum}

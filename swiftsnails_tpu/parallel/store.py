@@ -161,13 +161,26 @@ def push(
     grads: jax.Array,
     access: AccessMethod,
     lr,
+    exact: bool = False,
 ) -> TableState:
     """Apply sparse gradients (``GlobalPushAccess`` + server apply equivalent).
 
-    merge duplicates -> :func:`apply_rows`. Each unique row is touched exactly
-    once. Under pjit this compiles to the reduce/scatter collectives that
+    Fast path (default): the access method's sort-free ``scatter_update``
+    when it has one — for SGD bit-identical to the exact path, for AdaGrad
+    the per-sample-accumulator variant (see ``AccessMethod.scatter_update``).
+
+    Exact path (``exact=True`` or no scatter rule): merge duplicates
+    (argsort + segment-sum, the reference's ``merge_push_value`` semantics)
+    -> :func:`apply_rows`, each unique row touched exactly once.
+
+    Under pjit either path compiles to the reduce/scatter collectives that
     replace every WORKER_PUSH_REQUEST (§3.4).
     """
+    if not exact:
+        fast = access.scatter_update(state.table, state.slots, rows, grads, lr)
+        if fast is not None:
+            table, slots = fast
+            return TableState(table=table, slots=slots)
     uniq, merged = merge_duplicate_rows(rows, grads, invalid_row=state.capacity)
     table, slots = apply_rows(state.table, state.slots, uniq, merged, access, lr)
     return TableState(table=table, slots=slots)

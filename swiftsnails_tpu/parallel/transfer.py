@@ -75,8 +75,13 @@ def push_collective(
     grads: jax.Array,
     access: AccessMethod,
     lr,
+    exact: bool = False,
 ) -> TableState:
-    """Sharded scatter-update with explicit all_gather-over-data (push protocol)."""
+    """Sharded scatter-update with explicit all_gather-over-data (push protocol).
+
+    Uses the same fast/exact update paths as :func:`~swiftsnails_tpu.parallel.
+    store.push`, applied per model shard, so both data planes stay equivalent.
+    """
     per = _rows_per_shard(state.capacity, mesh)
     slot_keys = sorted(state.slots.keys())
 
@@ -88,6 +93,10 @@ def push_collective(
         owned = (local_ids >= 0) & (local_ids < per)
         local_ids = jnp.where(owned, local_ids, per)  # unowned -> out of range
         grads_all = jnp.where(owned[:, None], grads_all, 0)
+        if not exact:
+            fast = access.scatter_update(table_shard, slot_shards, local_ids, grads_all, lr)
+            if fast is not None:
+                return fast
         uniq, merged = merge_duplicate_rows(local_ids, grads_all, invalid_row=per)
         return apply_rows(table_shard, slot_shards, uniq, merged, access, lr)
 

@@ -1,0 +1,69 @@
+"""Tracing / profiling hooks.
+
+The reference has no tracing at all (survey §5: glog timestamps and a chrono
+``Timer`` only). Here: ``jax.profiler`` integration — step-scoped trace
+annotations plus an on-demand Perfetto trace window, driven by two config
+keys:
+
+* ``profile_dir``   — where to write the trace (enables profiling);
+* ``profile_steps`` — "start,stop" step numbers for the capture window
+  (default "10,20": skips compile, captures 10 steady-state steps).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+from swiftsnails_tpu.utils.config import Config
+
+
+class StepProfiler:
+    """Start/stop a jax profiler trace around a configured step window."""
+
+    def __init__(self, config: Config):
+        self.trace_dir = config.get_str("profile_dir", "")
+        window = config.get_str("profile_steps", "10,20")
+        try:
+            start_s, stop_s = window.replace(";", ",").split(",")
+            self.start_step, self.stop_step = int(start_s), int(stop_s)
+        except ValueError:
+            raise ValueError(
+                f"profile_steps must be 'start,stop', got {window!r}"
+            ) from None
+        if self.start_step >= self.stop_step:
+            raise ValueError(
+                f"profile_steps start must be < stop, got {window!r}"
+            )
+        self._active = False
+        self._finished = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.trace_dir)
+
+    def on_step(self, step: int) -> None:
+        if not self.enabled or self._finished:
+            return
+        # >= not ==: a resumed run may enter past the window start
+        if not self._active and self.start_step <= step < self.stop_step:
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+        elif self._active and step >= self.stop_step:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._finished = True
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+@contextlib.contextmanager
+def step_annotation(name: str, step: int) -> Iterator[None]:
+    """Label host-side work for the profiler timeline."""
+    with jax.profiler.StepTraceAnnotation(name, step_num=step):
+        yield

@@ -1,0 +1,25 @@
+"""Pallas kernels in interpret mode on CPU (real-hardware timing is bench.py's job)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.ops.pallas_embed import gather_rows
+
+
+def test_gather_rows_matches_take():
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.normal(size=(256, 64)).astype(np.float32))
+    rows = jnp.asarray(rng.integers(0, 256, size=128).astype(np.int32))
+    got = gather_rows(table, rows, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(table)[np.asarray(rows)])
+
+
+def test_gather_rows_duplicates_and_order():
+    table = jnp.arange(32, dtype=jnp.float32).reshape(8, 4) * 10
+    rows = jnp.array([3, 3, 0, 7, 3], dtype=jnp.int32)
+    got = np.asarray(gather_rows(table, rows, interpret=True))
+    want = np.asarray(table)[[3, 3, 0, 7, 3]]
+    np.testing.assert_array_equal(got, want)

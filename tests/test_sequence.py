@@ -1,0 +1,78 @@
+"""Sequence-parallel attention vs the dense single-device reference, on an
+8-device seq mesh. Forward and backward (autodiff through the ring scan)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from swiftsnails_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+from swiftsnails_tpu.parallel.sequence import (
+    reference_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+B, L, H, D = 2, 64, 8, 16
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({SEQ_AXIS: 8})
+
+
+@pytest.fixture(scope="module")
+def qkv(mesh):
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, L, H, D)).astype(np.float32))
+    spec = NamedSharding(mesh, P(None, SEQ_AXIS, None, None))
+    return tuple(jax.device_put(mk(), spec) for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_reference(mesh, qkv, causal):
+    q, k, v = qkv
+    got = np.asarray(ring_attention(mesh, q, k, v, causal=causal))
+    want = np.asarray(reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_reference(mesh, qkv, causal):
+    q, k, v = qkv
+    got = np.asarray(ulysses_attention(mesh, q, k, v, causal=causal))
+    want = np.asarray(reference_attention(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_backward(mesh, qkv):
+    """Ring attention must be differentiable (scan + ppermute VJP)."""
+    q, k, v = qkv
+
+    def loss_ring(q, k, v):
+        return (ring_attention(mesh, q, k, v, causal=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf), rtol=5e-3, atol=5e-4)
+
+
+def test_ring_jit_under_mesh(mesh, qkv):
+    q, k, v = qkv
+    fn = jax.jit(lambda q, k, v: ring_attention(mesh, q, k, v, causal=True))
+    out = fn(q, k, v)
+    assert out.shape == (B, L, H, D)
+    # output keeps the sequence sharding
+    assert out.sharding.spec == P(None, SEQ_AXIS, None, None)
+
+
+def test_ulysses_rejects_bad_heads(mesh, qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError):
+        ulysses_attention(mesh, q[:, :, :3], k[:, :, :3], v[:, :, :3])

@@ -78,18 +78,50 @@ def test_push_sgd_duplicates_additive(mesh):
     np.testing.assert_allclose(after[untouched], before[untouched])
 
 
-def test_push_adagrad(mesh):
+def test_push_adagrad_exact_merge(mesh):
+    """Reference merge_push_value semantics: duplicates merge before the
+    update rule, accum gets (sum g)^2."""
+    access = AdaGradAccess(eps=1e-8)
+    state = create_table(CAP, DIM, access, mesh=mesh, seed=4)
+    before = np.asarray(state.table).copy()
+    rows = jnp.array([4, 4], dtype=jnp.int32)
+    grads = jnp.full((2, DIM), 2.0, dtype=jnp.float32)
+    new = push(state, rows, grads, access, 0.5, exact=True)
+    # merged grad = 4.0; accum = 16; step = 0.5*4/sqrt(16+eps) ~ 0.5
+    after = np.asarray(new.table)
+    np.testing.assert_allclose(after[4], before[4] - 0.5, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new.slots["accum"])[4], 16.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new.slots["accum"])[0], 0.0)
+
+
+def test_push_adagrad_scatter_fast_path(mesh):
+    """Default sort-free path: per-sample accumulator (accum += sum g_i^2),
+    every duplicate scaled by the post-update accumulator."""
     access = AdaGradAccess(eps=1e-8)
     state = create_table(CAP, DIM, access, mesh=mesh, seed=4)
     before = np.asarray(state.table).copy()
     rows = jnp.array([4, 4], dtype=jnp.int32)
     grads = jnp.full((2, DIM), 2.0, dtype=jnp.float32)
     new = push(state, rows, grads, access, 0.5)
-    # merged grad = 4.0; accum = 16; step = 0.5*4/sqrt(16+eps) ~ 0.5
+    # accum = 2^2 + 2^2 = 8; each step = 0.5*2/sqrt(8) ; two steps
     after = np.asarray(new.table)
-    np.testing.assert_allclose(after[4], before[4] - 0.5, rtol=1e-5)
-    np.testing.assert_allclose(np.asarray(new.slots["accum"])[4], 16.0, rtol=1e-6)
-    np.testing.assert_allclose(np.asarray(new.slots["accum"])[0], 0.0)
+    step = 2 * 0.5 * 2.0 / np.sqrt(8.0)
+    np.testing.assert_allclose(after[4], before[4] - step, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(new.slots["accum"])[4], 8.0, rtol=1e-6)
+
+
+def test_push_sgd_scatter_matches_exact(mesh):
+    """SGD scatter path is bit-equivalent to the exact merge path."""
+    access = SgdAccess()
+    state = create_table(CAP, DIM, access, mesh=mesh, seed=8)
+    rng = np.random.default_rng(2)
+    rows = jnp.asarray(rng.integers(0, CAP, size=32).astype(np.int32))
+    grads = jnp.asarray(rng.normal(size=(32, DIM)).astype(np.float32))
+    fast = push(state, rows, grads, access, 0.1)
+    exact = push(state, rows, grads, access, 0.1, exact=True)
+    np.testing.assert_allclose(
+        np.asarray(fast.table), np.asarray(exact.table), rtol=1e-5, atol=1e-7
+    )
 
 
 def test_collective_paths_match_pjit(mesh):
