@@ -39,8 +39,18 @@ from swiftsnails_tpu.data.sampler import (
 from swiftsnails_tpu.data.text import encode_corpus
 from swiftsnails_tpu.data.vocab import Vocab
 from swiftsnails_tpu.ops.hashing import hash_row
+from swiftsnails_tpu.ops.rowdma import unpack_rows
 from swiftsnails_tpu.parallel.access import SgdAccess
-from swiftsnails_tpu.parallel.store import TableState, create_table, pull, push
+from swiftsnails_tpu.parallel.store import (
+    PackedTableState,
+    TableState,
+    create_packed_table,
+    create_table,
+    pull,
+    pull_packed,
+    push,
+    push_packed,
+)
 from swiftsnails_tpu.framework.trainer import Trainer
 from swiftsnails_tpu.utils.config import Config
 
@@ -102,6 +112,24 @@ class Word2VecTrainer(Trainer):
         self.table_dtype = {
             "float32": jnp.float32, "bfloat16": jnp.bfloat16,
         }[cfg.get_str("table_dtype", "float32")]
+        # Fast path: packed [C, S, 128] tables + row-DMA kernels (single
+        # device; the mesh path keeps the 2-D pjit layout). See ops/rowdma.
+        self.packed = cfg.get_bool("packed", True) and mesh is None
+        # Negative sampling mode: "pool" shares a pool of `pool_size`
+        # negatives across each `pool_block` consecutive pairs, scored on the
+        # MXU and down-weighted by negatives/pool_size — same expected SGNS
+        # gradient, a fraction of the row traffic. "per_pair" is the
+        # reference-faithful independent-K sampling ("pool" needs packed
+        # tables; the dense path always trains per-pair).
+        self.neg_mode = cfg.get_str("neg_mode", "pool" if self.packed else "per_pair")
+        if self.neg_mode == "pool" and not self.packed:
+            raise ValueError("neg_mode: pool requires packed tables (packed: 1)")
+        self.pool_size = cfg.get_int("pool_size", 64)
+        self.pool_block = cfg.get_int("pool_block", 512)
+        # scan this many optimizer substeps per dispatch (amortizes host->TPU
+        # dispatch latency). NOTE: TrainLoop steps/checkpoints count
+        # dispatches, so substeps scale throughput, not the step counter.
+        self.steps_per_call = max(cfg.get_int("steps_per_call", 1), 1)
 
         if corpus_ids is None:
             data_path = cfg.get_str("data")
@@ -125,12 +153,13 @@ class Word2VecTrainer(Trainer):
     # -- state -------------------------------------------------------------
 
     def init_state(self) -> W2VState:
-        in_table = create_table(
+        make = create_packed_table if self.packed else create_table
+        in_table = make(
             self.capacity, self.dim, self.access, mesh=self.mesh, seed=self.seed,
             dtype=self.table_dtype,
         )
         # reference word2vec inits syn1neg to zeros; init_scale=0 keeps that
-        out_table = create_table(
+        out_table = make(
             self.capacity, self.dim, self.access, mesh=self.mesh,
             seed=self.seed + 1, init_scale=0.0, dtype=self.table_dtype,
         )
@@ -164,12 +193,15 @@ class Word2VecTrainer(Trainer):
                     if self.subsample > 0:
                         chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
                     centers, contexts = skipgram_pairs(chunk, self.window, rng)
-                yield from batch_stream(centers, contexts, self.batch_size, rng)
+                # macro-batches: steps_per_call optimizer steps per dispatch
+                yield from batch_stream(
+                    centers, contexts, self.batch_size * self.steps_per_call, rng
+                )
 
     # -- step --------------------------------------------------------------
 
-    def train_step(self, state: W2VState, batch, rng):
-        centers, contexts = batch["centers"], batch["contexts"]
+    def _substep_dense(self, state: W2VState, centers, contexts, rng):
+        """Reference-faithful substep: per-pair negatives, 2-D tables."""
         b = centers.shape[0]
         k = self.negatives
         negs = alias_sample(self.neg_alias, rng, (b, k))
@@ -185,15 +217,129 @@ class Word2VecTrainer(Trainer):
         loss, (dv, du) = jax.value_and_grad(loss_of, argnums=(0, 1))(v, u)
         in_table = push(state.in_table, in_rows, dv, self.access, self.lr)
         out_table = push(state.out_table, out_rows, du, self.access, self.lr)
-        return W2VState(in_table, out_table), {"loss": loss}
+        return W2VState(in_table, out_table), loss
+
+    def _substep_packed(self, state: W2VState, centers, contexts, rng):
+        """Fast substep: packed tables, row-DMA pull/push, pooled negatives.
+
+        Each block of ``pool_block`` consecutive pairs shares ``pool_size``
+        negatives; the pair x pool scores are one MXU matmul per block
+        (einsum below) and the SGNS negative term is weighted by
+        ``negatives / pool_size`` so the expected gradient matches K
+        independent draws. Row traffic per pair drops from 2(1+K) rows to
+        ~2(2 + pool/block) — the difference between an issue-bound scatter
+        and the MXU doing the work.
+        """
+        b = centers.shape[0]
+        # largest divisor of b not exceeding pool_block (b is static under
+        # jit, so this runs at trace time; non-divisible batches still work)
+        pb = min(self.pool_block, b)
+        while b % pb:
+            pb -= 1
+        nb = b // pb
+        pn = self.pool_size
+        lam = self.negatives / pn
+        pools = alias_sample(self.neg_alias, rng, (nb, pn))
+        in_rows = self._rows(centers)
+        pos_rows = self._rows(contexts)
+        pool_rows = self._rows(pools.reshape(-1))
+        out_rows = jnp.concatenate([pos_rows, pool_rows])
+
+        v = pull_packed(state.in_table, in_rows)
+        u = pull_packed(state.out_table, out_rows)
+        u_pos = u[:b]
+        pool = u[b:].reshape(nb, pn, *u.shape[1:])
+
+        def loss_of(v, u_pos, pool):
+            pos = jnp.einsum("bsl,bsl->b", v, u_pos, preferred_element_type=jnp.float32)
+            vb = v.reshape(nb, pb, *v.shape[1:])
+            neg = jnp.einsum(
+                "npsl,nqsl->npq", vb, pool, preferred_element_type=jnp.float32
+            )
+            return -(
+                jax.nn.log_sigmoid(pos).mean()
+                + lam * jax.nn.log_sigmoid(-neg).sum(axis=-1).mean()
+            )
+
+        loss, (dv, du_pos, dpool) = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
+            v, u_pos, pool
+        )
+        du = jnp.concatenate([du_pos, dpool.reshape(-1, *dpool.shape[2:])])
+        in_table = push_packed(state.in_table, in_rows, dv, self.access, self.lr)
+        out_table = push_packed(state.out_table, out_rows, du, self.access, self.lr)
+        return W2VState(in_table, out_table), loss
+
+    def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng):
+        """Packed tables with reference-faithful per-pair K negatives."""
+        b = centers.shape[0]
+        k = self.negatives
+        negs = alias_sample(self.neg_alias, rng, (b, k))
+        in_rows = self._rows(centers)
+        out_rows = self._rows(jnp.concatenate([contexts, negs.reshape(-1)]))
+
+        v = pull_packed(state.in_table, in_rows)
+        u = pull_packed(state.out_table, out_rows)
+        u_pos = u[:b]
+        u_neg = u[b:].reshape(b, k, *u.shape[1:])
+
+        def loss_of(v, u_pos, u_neg):
+            pos = jnp.einsum("bsl,bsl->b", v, u_pos, preferred_element_type=jnp.float32)
+            neg = jnp.einsum("bsl,bksl->bk", v, u_neg, preferred_element_type=jnp.float32)
+            return -(
+                jax.nn.log_sigmoid(pos) + jax.nn.log_sigmoid(-neg).sum(axis=-1)
+            ).mean()
+
+        loss, (dv, du_pos, du_neg) = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(
+            v, u_pos, u_neg
+        )
+        du = jnp.concatenate([du_pos, du_neg.reshape(-1, *du_neg.shape[2:])])
+        in_table = push_packed(state.in_table, in_rows, dv, self.access, self.lr)
+        out_table = push_packed(state.out_table, out_rows, du, self.access, self.lr)
+        return W2VState(in_table, out_table), loss
+
+    def train_step(self, state: W2VState, batch, rng):
+        """One dispatch = ``steps_per_call`` optimizer substeps under lax.scan."""
+        centers, contexts = batch["centers"], batch["contexts"]
+        n = centers.shape[0]
+        t = max(n // self.batch_size, 1)
+        b = n // t
+        if self.packed:
+            substep = (
+                self._substep_packed
+                if self.neg_mode == "pool"
+                else self._substep_packed_perpair
+            )
+        else:
+            substep = self._substep_dense
+
+        if t == 1:
+            state, loss = substep(state, centers, contexts, rng)
+            return state, {"loss": loss}
+
+        def body(st, xs):
+            c, x, key = xs
+            st, loss = substep(st, c, x, key)
+            return st, loss
+
+        keys = jax.random.split(rng, t)
+        state, losses = jax.lax.scan(
+            body, state, (centers.reshape(t, b), contexts.reshape(t, b), keys)
+        )
+        return state, {"loss": losses.mean()}
 
     # -- export (ServerTerminate parity: text dump of the table) -----------
 
+    def _all_vocab_rows(self, state: W2VState) -> np.ndarray:
+        ids = self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32))
+        if self.packed:
+            vals = unpack_rows(state.in_table.table.at[ids].get(mode="promise_in_bounds"),
+                               self.dim)
+        else:
+            vals = pull(state.in_table, ids)
+        return np.asarray(vals, dtype=np.float32)  # bf16: ml_dtypes don't format
+
     def export_text(self, state: W2VState, path: str) -> None:
-        rows = np.asarray(
-            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32))),
-            dtype=np.float32,  # bf16 tables: ml_dtypes scalars don't format
-        )
+        rows = self._all_vocab_rows(state)
         with open(path, "w", encoding="utf-8") as f:
             f.write(f"{len(self.vocab)} {self.dim}\n")
             for i, word in enumerate(self.vocab.words):
@@ -203,10 +349,7 @@ class Word2VecTrainer(Trainer):
     # -- eval: nearest neighbors for sanity checks --------------------------
 
     def neighbors(self, state: W2VState, word: str, topn: int = 10):
-        emb = np.asarray(
-            pull(state.in_table, self._rows(jnp.arange(len(self.vocab), dtype=jnp.int32))),
-            dtype=np.float32,
-        )
+        emb = self._all_vocab_rows(state)
         norms = np.linalg.norm(emb, axis=1, keepdims=True) + 1e-9
         emb = emb / norms
         q = emb[self.vocab.index[word]]

@@ -4,8 +4,10 @@ from swiftsnails_tpu.ops.hashing import (
     murmur_fmix64_pair,
     hash_row,
 )
+from swiftsnails_tpu.ops import rowdma
 
 __all__ = [
+    "rowdma",
     "murmur_fmix64",
     "murmur_fmix64_np",
     "murmur_fmix64_pair",

@@ -1,0 +1,315 @@
+"""Row-granularity DMA kernels on the packed table layout — the PS hot path.
+
+The reference's server hot loop is a per-key hashmap probe under a lock
+(``src/core/parameter/sparsetable.h:142-149`` find-or-init per pulled key;
+``sparsetable.h:181-192`` apply per pushed key). The TPU equivalent of "one
+key = one independent memory transaction" is one row DMA per key: XLA's own
+gather/scatter on a ``[capacity, dim]`` table serializes at ~100-140 ns/row
+on v5e (measured), so these kernels drive the DMA engines directly.
+
+Layout: a **packed table** of shape ``[capacity, S, 128]`` (``S = ceil(dim/
+128)``), i.e. one row = one ``(S, 128)`` tile. Mosaic requires DMA slices to
+be tile-aligned in the last two dims — a row of a 2-D ``[C, D]`` table can
+never be sliced alone (sublane tiling is 8), but a leading-dim slice of the
+3-D layout is exactly one row with zero padding waste. Row elements live at
+``packed[r, s, l] == row[s * 128 + l]``; all framework math (dots, grads,
+optimizer rules) is layout-agnostic — padding lanes hold zeros and stay zero
+under every access method whose update is ``f(grad) == 0`` at ``grad == 0``.
+
+Kernels (both double-buffered, one DMA per row, shared per-slot semaphore —
+the TPU's semaphore space caps out near 512, so per-row semaphores are not
+an option; equal-sized copies make shared byte-accounting exact):
+
+* :func:`gather_rows` — pull: for each of N row ids, DMA ``table[r]`` HBM ->
+  VMEM, emitting ``[N, S, 128]``. Block ``i+1``'s row DMAs are issued before
+  block ``i`` is consumed, so issue latency overlaps the output pipeline.
+* :func:`scatter_add_rows` — push: read-modify-write ``table[r] += delta``
+  per row, pipelined two blocks deep (reads of block ``i+1`` overlap writes
+  of block ``i``). Rows MUST be unique (or >= capacity for padding slots,
+  which are skipped): uniqueness is what makes the RMW race-free, and is
+  guaranteed by the caller via ``merge_duplicate_rows`` (the reference's
+  ``merge_push_value`` duplicate merge, ``sparsetable.h:176-179``).
+
+Off-TPU these run in interpret mode (same code path, CPU tests). The XLA
+fallback (`jnp.take` / `.at[].add`) remains in ``parallel/store.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROW_LANES = 128
+
+
+def packed_shape(capacity: int, dim: int):
+    """[capacity, S, 128] shape for a logical [capacity, dim] table."""
+    s = -(-dim // ROW_LANES)
+    return (capacity, s, ROW_LANES)
+
+
+def pack_rows(rows2d: jax.Array) -> jax.Array:
+    """[N, dim] -> [N, S, 128] with zero padding lanes."""
+    n, dim = rows2d.shape
+    s = -(-dim // ROW_LANES)
+    pad = s * ROW_LANES - dim
+    if pad:
+        rows2d = jnp.pad(rows2d, ((0, 0), (0, pad)))
+    return rows2d.reshape(n, s, ROW_LANES)
+
+
+def unpack_rows(rows3d: jax.Array, dim: int) -> jax.Array:
+    """[N, S, 128] -> [N, dim]."""
+    n = rows3d.shape[0]
+    return rows3d.reshape(n, -1)[:, :dim]
+
+
+# --------------------------------------------------------------- gather ---
+
+
+def _gather_kernel(rows_ref, table_ref, out_ref, scratch, sems):
+    R = scratch.shape[1]
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    def row_dma(b, slot, j):
+        return pltpu.make_async_copy(
+            table_ref.at[rows_ref[b * R + j]], scratch.at[slot, j], sems.at[slot]
+        )
+
+    def start_block(b, slot):
+        jax.lax.fori_loop(0, R, lambda j, _: (row_dma(b, slot, j).start(), 0)[1], 0)
+
+    @pl.when(i == 0)
+    def _():
+        start_block(0, 0)
+
+    @pl.when(i + 1 < nblocks)
+    def _():
+        start_block(i + 1, (i + 1) % 2)
+
+    slot = i % 2
+    jax.lax.fori_loop(0, R, lambda j, _: (row_dma(i, slot, j).wait(), 0)[1], 0)
+    out_ref[...] = scratch[slot]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "interpret")
+)
+def gather_rows(
+    table: jax.Array, rows: jax.Array, block_rows: int = 512, interpret: bool = False
+) -> jax.Array:
+    """``table[rows]`` for a packed ``[C, S, 128]`` table -> ``[N, S, 128]``.
+
+    ``N`` must be a multiple of ``block_rows``; rows must be in
+    ``[0, capacity)``. One DMA per row, double-buffered across blocks.
+    """
+    n = rows.shape[0]
+    c, s, lanes = table.shape
+    if n % block_rows:
+        raise ValueError(f"N={n} not a multiple of block_rows={block_rows}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block_rows,),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((block_rows, s, lanes), lambda i, rows_ref: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows, s, lanes), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, s, lanes), table.dtype),
+        interpret=interpret,
+    )(rows.astype(jnp.int32), table)
+
+
+# ---------------------------------------------------------- scatter-add ---
+
+
+def _scatter_kernel(rows_ref, table_in_ref, deltas_ref, table_ref,
+                    scratch, read_sems, write_sems):
+    # table_ref is the aliased output (same HBM buffer as table_in_ref).
+    del table_in_ref
+    R = scratch.shape[1]
+    C = table_ref.shape[0]
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    def read_dma(b, slot, j):
+        return pltpu.make_async_copy(
+            table_ref.at[rows_ref[b * R + j]], scratch.at[slot, j], read_sems.at[slot]
+        )
+
+    def write_dma(b, slot, j):
+        return pltpu.make_async_copy(
+            scratch.at[slot, j], table_ref.at[rows_ref[b * R + j]], write_sems.at[slot]
+        )
+
+    def for_valid(b, fn):
+        def body(j, _):
+            @pl.when(rows_ref[b * R + j] < C)
+            def _():
+                fn(j)
+            return 0
+        jax.lax.fori_loop(0, R, body, 0)
+
+    @pl.when(i == 0)
+    def _():
+        for_valid(0, lambda j: read_dma(0, 0, j).start())
+
+    @pl.when(i + 1 < nblocks)
+    def _():
+        slot_next = (i + 1) % 2
+
+        # block i-1 used slot_next; its writebacks must land before we
+        # overwrite the slot's scratch with new reads.
+        @pl.when(i >= 1)
+        def _():
+            for_valid(i - 1, lambda j: write_dma(i - 1, slot_next, j).wait())
+
+        for_valid(i + 1, lambda j: read_dma(i + 1, slot_next, j).start())
+
+    slot = i % 2
+
+    def rmw(j):
+        read_dma(i, slot, j).wait()
+        scratch[slot, j] = scratch[slot, j] + deltas_ref[j]
+        write_dma(i, slot, j).start()
+
+    for_valid(i, rmw)
+
+    @pl.when(i == nblocks - 1)
+    def _():
+        for_valid(i, lambda j: write_dma(i, slot, j).wait())
+
+        @pl.when(nblocks >= 2)
+        def _():
+            for_valid(i - 1, lambda j: write_dma(i - 1, (i - 1) % 2, j).wait())
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "interpret"),
+    donate_argnums=(0,),
+)
+def scatter_add_rows(
+    table: jax.Array,
+    rows: jax.Array,
+    deltas: jax.Array,
+    block_rows: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``table[rows] += deltas`` in place for UNIQUE rows (packed layout).
+
+    Rows ``>= capacity`` are padding and skipped (the ``mode='drop'``
+    equivalent). The table buffer is donated and aliased — no copy.
+    """
+    n = rows.shape[0]
+    c, s, lanes = table.shape
+    if n % block_rows:
+        raise ValueError(f"N={n} not a multiple of block_rows={block_rows}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block_rows, s, lanes), lambda i, rows_ref: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows, s, lanes), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        _scatter_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(rows.astype(jnp.int32), table, deltas)
+
+
+# -------------------------------------------------------- scatter-write ---
+
+
+def _write_kernel(rows_ref, table_in_ref, values_ref, table_ref, sems):
+    # Write-only scatter: each valid row of the streamed-in values block is
+    # DMA'd VMEM -> HBM. Unique rows => no write races. All of a block's
+    # writes are issued, then drained before the body returns: the input
+    # pipeline prefetches block i+1 over block i-1's buffer while body i
+    # runs, so writes must never outlive their own block's body.
+    del table_in_ref
+    R = values_ref.shape[0]
+    C = table_ref.shape[0]
+    i = pl.program_id(0)
+
+    def write_dma(j):
+        return pltpu.make_async_copy(
+            values_ref.at[j], table_ref.at[rows_ref[i * R + j]], sems.at[0]
+        )
+
+    def for_valid(fn):
+        def body(j, _):
+            @pl.when(rows_ref[i * R + j] < C)
+            def _():
+                fn(j)
+            return 0
+        jax.lax.fori_loop(0, R, body, 0)
+
+    for_valid(lambda j: write_dma(j).start())
+    for_valid(lambda j: write_dma(j).wait())
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "interpret"),
+    donate_argnums=(0,),
+)
+def scatter_write_rows(
+    table: jax.Array,
+    rows: jax.Array,
+    values: jax.Array,
+    block_rows: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """``table[rows] = values`` in place for UNIQUE rows (packed layout).
+
+    Write-only half of a generic pull-compute-writeback update (AdaGrad and
+    friends); rows ``>= capacity`` are skipped.
+    """
+    n = rows.shape[0]
+    c, s, lanes = table.shape
+    if n % block_rows:
+        raise ValueError(f"N={n} not a multiple of block_rows={block_rows}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block_rows, s, lanes), lambda i, rows_ref: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((1,))],
+    )
+    return pl.pallas_call(
+        _write_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(rows.astype(jnp.int32), table, values)
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() in ("tpu", "axon")

@@ -189,3 +189,139 @@ def push(
 def export_rows(state: TableState, rows: jax.Array) -> jax.Array:
     """Raw row read (no pull transform) — used by checkpoint/text export."""
     return state.table.at[rows].get(mode="fill", fill_value=0)
+
+
+# ------------------------------------------------------- packed variant ---
+#
+# The DMA-kernel data plane (ops/rowdma.py): rows live as [S, 128] tiles of
+# a [capacity, S, 128] table so one key == one row DMA, replacing XLA's
+# serialized gather/scatter (~100-140 ns/row on v5e) with pipelined row DMAs.
+# Padding lanes hold zeros and stay zero: every access rule satisfies
+# update(grad=0) == 0. Same pull/push contract as the 2-D table above.
+
+
+class PackedTableState(NamedTuple):
+    """Packed sharded table [capacity, S, 128] + row-aligned slots.
+
+    The logical row width (dim) is not part of the state — trainers own it;
+    padding lanes are zero by construction and stay zero.
+    """
+
+    table: jax.Array
+    slots: Slots
+
+    @property
+    def capacity(self) -> int:
+        return self.table.shape[0]
+
+
+def create_packed_table(
+    capacity: int,
+    dim: int,
+    access: AccessMethod,
+    mesh: Optional[Mesh] = None,
+    dtype=jnp.float32,
+    seed: int = 0,
+    init_scale: Optional[float] = None,
+) -> PackedTableState:
+    """Packed-layout twin of :func:`create_table` (padding lanes zeroed)."""
+    from swiftsnails_tpu.ops.rowdma import ROW_LANES, packed_shape
+
+    shape = packed_shape(capacity, dim)
+    s = shape[1]
+
+    def init():
+        rng = jax.random.PRNGKey(seed)
+        # init as if [capacity, dim]: same distribution, packed placement
+        param = access.init_param(rng, (capacity, s * ROW_LANES), dtype)
+        if init_scale is not None:
+            param = param * init_scale
+        lane = jnp.arange(s * ROW_LANES) < dim
+        param = jnp.where(lane[None, :], param, 0).reshape(shape)
+        slots = access.init_slots((capacity, s * ROW_LANES), dtype)
+        slots = {k: v.reshape(shape) for k, v in slots.items()}
+        return PackedTableState(table=param, slots=slots)
+
+    if mesh is None:
+        out = jax.jit(init, static_argnums=())()
+        return out
+    sharding = table_sharding(mesh)  # rows sharded over "model"; S,128 whole
+    slot_spec = jax.eval_shape(lambda: access.init_slots((capacity, s * ROW_LANES), dtype))
+    state_shardings = PackedTableState(
+        table=sharding, slots={k: sharding for k in slot_spec}
+    )
+    return jax.jit(init, out_shardings=state_shardings)()
+
+
+def _pad_to_block(rows: jax.Array, invalid_row: int, block: int):
+    n = rows.shape[0]
+    padded = -(-n // block) * block
+    if padded == n:
+        return rows, n
+    return jnp.concatenate(
+        [rows, jnp.full((padded - n,), invalid_row, rows.dtype)]
+    ), n
+
+
+def pull_packed(state: PackedTableState, rows: jax.Array,
+                block_rows: int = 512) -> jax.Array:
+    """Gather packed rows -> [N, S, 128] (pull protocol, DMA kernel on TPU)."""
+    from swiftsnails_tpu.ops import rowdma
+
+    if rowdma.on_tpu():
+        padded, n = _pad_to_block(rows, 0, block_rows)
+        out = rowdma.gather_rows(state.table, padded, block_rows=block_rows)
+        return out[:n]
+    return state.table.at[rows].get(mode="promise_in_bounds")
+
+
+def push_packed(
+    state: PackedTableState,
+    rows: jax.Array,
+    grads: jax.Array,
+    access: AccessMethod,
+    lr,
+    block_rows: int = 512,
+) -> PackedTableState:
+    """Merge duplicates -> apply access rule -> row-DMA writeback.
+
+    ``grads`` is [N, S, 128]. The merge (argsort + segment-sum) implements
+    ``merge_push_value`` exactly; unique rows make the DMA writeback
+    race-free. SGD takes the add-only RMW kernel (one launch); other access
+    methods gather current rows+slots, apply, and write back.
+    """
+    from swiftsnails_tpu.ops import rowdma
+    from swiftsnails_tpu.parallel.access import SgdAccess
+
+    cap = state.capacity
+    uniq, merged = merge_duplicate_rows(rows, grads, invalid_row=cap)
+    if not rowdma.on_tpu():
+        table, slots = apply_rows(state.table, state.slots, uniq, merged, access, lr)
+        return PackedTableState(table=table, slots=slots)
+
+    uniq, n = _pad_to_block(uniq, cap, block_rows)
+    if n != merged.shape[0]:
+        pad = uniq.shape[0] - merged.shape[0]
+        merged = jnp.concatenate([merged, jnp.zeros((pad,) + merged.shape[1:], merged.dtype)])
+
+    if isinstance(access, SgdAccess) and not state.slots:
+        deltas = (-lr * merged).astype(state.table.dtype)
+        table = rowdma.scatter_add_rows(state.table, uniq, deltas, block_rows=block_rows)
+        return PackedTableState(table=table, slots=state.slots)
+
+    safe = jnp.where(uniq < cap, uniq, 0)
+    cur = rowdma.gather_rows(state.table, safe, block_rows=block_rows)
+    cur_slots = {
+        k: rowdma.gather_rows(v, safe, block_rows=block_rows)
+        for k, v in state.slots.items()
+    }
+    new_param, new_slots = access.apply_push_value(cur, cur_slots, merged, lr)
+    table = rowdma.scatter_write_rows(state.table, uniq, new_param.astype(state.table.dtype),
+                                       block_rows=block_rows)
+    slots = {
+        k: rowdma.scatter_write_rows(state.slots[k], uniq,
+                                     new_slots[k].astype(state.slots[k].dtype),
+                                     block_rows=block_rows)
+        for k in state.slots
+    }
+    return PackedTableState(table=table, slots=slots)

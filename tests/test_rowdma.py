@@ -1,0 +1,187 @@
+"""Row-DMA kernels (interpret mode) + packed store + packed/pooled Word2Vec.
+
+The kernels are exercised through pallas interpret mode on the CPU mesh —
+same code path the TPU compiles (SURVEY §4's loopback-test analog at the
+kernel level).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.ops import rowdma
+from swiftsnails_tpu.parallel.access import AdaGradAccess, SgdAccess
+from swiftsnails_tpu.parallel.store import (
+    PackedTableState,
+    create_packed_table,
+    merge_duplicate_rows,
+    pull_packed,
+    push_packed,
+)
+
+
+def _mk_table(c=64, s=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.random((c, s, 128), dtype=np.float32))
+
+
+def test_gather_rows_interpret():
+    table = _mk_table()
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 64, 32).astype(np.int32)
+    got = rowdma.gather_rows(table, jnp.asarray(rows), block_rows=8, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(table)[rows])
+
+
+def test_scatter_add_rows_interpret_unique_and_padding():
+    table = _mk_table()
+    rows = np.array([3, 1, 7, 64, 64, 9, 2, 64], dtype=np.int32)  # 64 = padding
+    deltas = np.random.default_rng(2).random((8, 2, 128)).astype(np.float32)
+    want = np.asarray(table).copy()
+    for r, d in zip(rows, deltas):
+        if r < 64:
+            want[r] += d
+    got = rowdma.scatter_add_rows(
+        jnp.asarray(table), jnp.asarray(rows), jnp.asarray(deltas),
+        block_rows=4, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_scatter_write_rows_interpret():
+    table = _mk_table()
+    rows = np.array([5, 0, 63, 64], dtype=np.int32)
+    vals = np.random.default_rng(3).random((4, 2, 128)).astype(np.float32)
+    want = np.asarray(table).copy()
+    for r, v in zip(rows, vals):
+        if r < 64:
+            want[r] = v
+    got = rowdma.scatter_write_rows(
+        jnp.asarray(table), jnp.asarray(rows), jnp.asarray(vals),
+        block_rows=4, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    x = rng.random((10, 200)).astype(np.float32)
+    packed = rowdma.pack_rows(jnp.asarray(x))
+    assert packed.shape == (10, 2, 128)
+    assert float(jnp.abs(packed.reshape(10, -1)[:, 200:]).max()) == 0.0
+    back = rowdma.unpack_rows(packed, 200)
+    np.testing.assert_array_equal(np.asarray(back), x)
+
+
+def test_packed_store_pull_push_sgd_matches_dense():
+    """push_packed (XLA fallback on CPU) == reference per-key SGD math."""
+    access = SgdAccess()
+    state = create_packed_table(32, 200, access, seed=0)
+    assert state.table.shape == (32, 2, 128)
+    rows = jnp.asarray(np.array([1, 5, 1, 31, 5, 5], dtype=np.int32))
+    grads2d = np.random.default_rng(4).random((6, 200)).astype(np.float32)
+    grads = rowdma.pack_rows(jnp.asarray(grads2d))
+
+    before = np.asarray(state.table).copy()
+    new = push_packed(state, rows, grads, access, lr=0.1)
+    want = before.reshape(32, -1).copy()
+    for r, g in zip(np.asarray(rows), grads2d):
+        want[r, :200] -= 0.1 * g
+    np.testing.assert_allclose(
+        np.asarray(new.table).reshape(32, -1), want, rtol=1e-5, atol=1e-6
+    )
+    # padding lanes still zero after the update
+    assert float(jnp.abs(new.table.reshape(32, -1)[:, 200:]).max()) == 0.0
+
+    pulled = pull_packed(new, jnp.asarray([1, 5], dtype=jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(pulled).reshape(2, -1)[:, :200], want[[1, 5], :200], rtol=1e-6
+    )
+
+
+def test_packed_store_adagrad_matches_2d():
+    """AdaGrad via packed apply == same rule on an equivalent 2-D table."""
+    from swiftsnails_tpu.parallel.store import TableState, create_table, push
+
+    access = AdaGradAccess()
+    packed = create_packed_table(16, 256, access, seed=1)
+    dense = TableState(
+        table=packed.table.reshape(16, 256),
+        slots={k: v.reshape(16, 256) for k, v in packed.slots.items()},
+    )
+    rows = jnp.asarray(np.array([2, 9, 2, 15], dtype=np.int32))
+    g2d = np.random.default_rng(5).random((4, 256)).astype(np.float32)
+    new_p = push_packed(packed, rows, jnp.asarray(g2d).reshape(4, 2, 128),
+                        access, lr=0.5)
+    new_d = push(dense, rows, jnp.asarray(g2d), access, lr=0.5, exact=True)
+    np.testing.assert_allclose(
+        np.asarray(new_p.table).reshape(16, 256), np.asarray(new_d.table),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(new_p.slots["accum"]).reshape(16, 256),
+        np.asarray(new_d.slots["accum"]), rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_word2vec_packed_pool_loss_decreases():
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    vocab_size = 50
+    counts = np.maximum(rng.integers(1, 50, vocab_size), 1).astype(np.int64)
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)], counts)
+    # structured corpus: consecutive tokens correlated -> learnable signal
+    base = np.repeat(np.arange(10), 40) % vocab_size
+    corpus = ((base + rng.integers(0, 2, base.size)) % vocab_size).astype(np.int32)
+    cfg = Config({
+        "dim": "16", "window": "2", "negatives": "3", "learning_rate": "0.1",
+        "batch_size": "64", "subsample": "0", "num_iters": "30",
+        "pool_size": "8", "pool_block": "32", "steps_per_call": "2",
+        "packed": "1", "use_native": "0",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None, corpus_ids=corpus, vocab=vocab)
+    assert tr.packed and tr.neg_mode == "pool"
+    state = tr.init_state()
+    assert isinstance(state.in_table, PackedTableState)
+    step = jax.jit(tr.train_step)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i, batch in enumerate(tr.batches()):
+        if batch["centers"].shape[0] % 64:
+            continue
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(key, i))
+        losses.append(float(m["loss"]))
+        if len(losses) >= 40:
+            break
+    assert len(losses) >= 10
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_word2vec_packed_export_and_neighbors(tmp_path):
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    vocab = Vocab([f"w{i}" for i in range(20)],
+                  np.maximum(rng.integers(1, 9, 20), 1).astype(np.int64))
+    cfg = Config({"dim": "8", "window": "2", "negatives": "2",
+                  "learning_rate": "0.1", "batch_size": "16", "subsample": "0",
+                  "num_iters": "1", "packed": "1"})
+    tr = Word2VecTrainer(cfg, mesh=None,
+                         corpus_ids=rng.integers(0, 20, 100).astype(np.int32),
+                         vocab=vocab)
+    state = tr.init_state()
+    out = tmp_path / "vec.txt"
+    tr.export_text(state, str(out))
+    lines = out.read_text().strip().split("\n")
+    assert lines[0] == "20 8"
+    assert len(lines) == 21
+    nb = tr.neighbors(state, "w0", topn=3)
+    assert len(nb) == 3

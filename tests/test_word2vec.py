@@ -39,6 +39,10 @@ def make_trainer(mesh=None, **overrides):
             "batch_size": "256",
             "subsample": "0",
             "seed": "0",
+            # this file tests the reference-faithful dense path (per-pair
+            # negatives, 2-D tables); the packed/pooled fast path has its
+            # own convergence + equivalence tests in test_rowdma.py
+            "packed": "0",
         }
     )
     for k, v in overrides.items():
