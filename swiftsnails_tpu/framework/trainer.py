@@ -21,6 +21,8 @@ Config keys honored (reference inventory, survey §2.9): ``num_iters``,
 
 from __future__ import annotations
 
+import queue
+import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
@@ -81,6 +83,61 @@ class Trainer:
         return {}
 
 
+class _Prefetcher:
+    """Bounded background-thread batch prefetch (``queue_with_capacity``
+    parity, ``src/utils/queue.h:100-108``): the producer thread runs the
+    trainer's host-side record parsing/sampling while the device computes.
+    A ``None`` sentinel is the poison value; producer errors re-raise on the
+    consumer side."""
+
+    _DONE = object()
+
+    def __init__(self, it: Iterator, depth: int = 2):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+        def produce():
+            try:
+                for item in it:
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # surfaced in __next__
+                self._err = e
+            finally:
+                self._q.put(self._DONE)
+
+        self._thread = threading.Thread(target=produce, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._DONE:
+            self._thread.join()
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so the producer's final put never blocks
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
 class TrainLoop:
     """The driver: jit with state donation, device feed, metrics, checkpoints."""
 
@@ -127,8 +184,10 @@ class TrainLoop:
                 step = restored_step
         root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
+        depth = trainer.config.get_int("prefetch_batches", 2)
+        batches = _Prefetcher(iter(trainer.batches()), depth=depth) if depth else trainer.batches()
         try:
-            for batch in trainer.batches():
+            for batch in batches:
                 n_items = trainer.items_per_batch(batch)
                 self.profiler.on_step(step)
                 with step_annotation(trainer.name, step):
@@ -147,6 +206,8 @@ class TrainLoop:
         finally:
             # an open trace must be finalized even on error/interrupt
             self.profiler.close()
+            if isinstance(batches, _Prefetcher):
+                batches.close()
         # block so throughput/final metrics are real, then final flush
         jax.block_until_ready(jax.tree_util.tree_leaves(state))
         if step % max(self.log_every, 1) != 0 or not self.log_every:

@@ -1,0 +1,83 @@
+"""Subprocess / pipe helpers for launcher tooling.
+
+Capability parity with the reference's ``GlobalShell`` (``src/utils/shell.h``:
+``make_pipe`` / ``execute`` / ``get_command_output`` over ``popen`` with
+``set -o pipefail``, fork-guarded by ``global_fork_mutex()``). The reference
+used these to stream training data out of HDFS pipes and to drive the
+Hadoop-Streaming launch scripts; here they back the ``tools/`` launchers and
+any ``data: "cmd |"`` pipe-style input.
+
+Python's ``subprocess`` already serializes fork internally, so the fork mutex
+disappears; ``pipefail`` is preserved by running through ``bash -o pipefail``.
+"""
+
+from __future__ import annotations
+
+import io
+import subprocess
+from typing import IO, Iterator, List, Optional
+
+
+def execute(cmd: str, check: bool = True) -> int:
+    """Run a shell command (``GlobalShell::execute`` parity, with pipefail)."""
+    proc = subprocess.run(["bash", "-o", "pipefail", "-c", cmd])
+    if check and proc.returncode != 0:
+        raise RuntimeError(f"command failed ({proc.returncode}): {cmd}")
+    return proc.returncode
+
+
+def get_command_output(cmd: str) -> str:
+    """Capture stdout (``GlobalShell::get_command_output`` parity)."""
+    proc = subprocess.run(
+        ["bash", "-o", "pipefail", "-c", cmd], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"command failed ({proc.returncode}): {cmd}\n{proc.stderr}"
+        )
+    return proc.stdout
+
+
+class ManagedPipe:
+    """Read-only command pipe (``GlobalShell::make_pipe('r')`` parity).
+
+    Usage::
+
+        with ManagedPipe("zcat corpus.gz") as f:
+            for line in f: ...
+    """
+
+    def __init__(self, cmd: str):
+        self.cmd = cmd
+        self._proc: Optional[subprocess.Popen] = None
+
+    def __enter__(self) -> IO[str]:
+        self._proc = subprocess.Popen(
+            ["bash", "-o", "pipefail", "-c", self.cmd],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        assert self._proc.stdout is not None
+        return self._proc.stdout
+
+    def __exit__(self, *exc) -> None:
+        assert self._proc is not None
+        if self._proc.stdout:
+            self._proc.stdout.close()
+        rc = self._proc.wait()
+        if rc != 0 and exc == (None, None, None):
+            raise RuntimeError(f"pipe command failed ({rc}): {self.cmd}")
+
+
+def open_maybe_pipe(path: str) -> IO[str]:
+    """Open a data path; a trailing ``|`` means "command pipe" (HDFS-pipe
+    pattern from the reference's deploy scripts)."""
+    if path.endswith("|"):
+        proc = subprocess.Popen(
+            ["bash", "-o", "pipefail", "-c", path[:-1].strip()],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        assert proc.stdout is not None
+        return proc.stdout
+    return open(path, "r", encoding="utf-8", errors="replace")

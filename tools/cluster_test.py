@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Single-host multi-process smoke test (``src/tools/cluster_test.sh`` parity).
+
+The reference's operational check launched master + server + worker with
+nohup on one box and watched master.log. Here the three roles are one SPMD
+``train`` role; the smoke test spawns N processes that rendezvous through the
+JAX coordination service (the master-equivalent), run a tiny distributed
+word2vec job on CPU devices, hit the end-of-training barrier, and exit 0.
+
+    python tools/cluster_test.py --nproc 2
+
+Each process logs to ``/tmp/snails_cluster_test/proc<i>.log`` (the master.log
+analog).
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+
+from swiftsnails_tpu.parallel.cluster import barrier, initialize_cluster, process_info
+from swiftsnails_tpu.utils.config import Config
+
+cfg = Config({
+    "master_addr": "127.0.0.1:" + port,
+    "expected_node_num": str(nproc),
+    "init_timeout": "60",
+})
+initialize_cluster(cfg, process_id=pid)
+idx, count = process_info()
+assert count == nproc, (idx, count)
+print(f"process {idx}/{count} joined", flush=True)
+
+# tiny single-process training on this node's data shard (data-parallel
+# across processes is by corpus split, reference stdin-split parity)
+from swiftsnails_tpu.data.vocab import Vocab
+from swiftsnails_tpu.framework.trainer import TrainLoop
+from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+
+rng = np.random.default_rng(idx)
+vocab = Vocab([f"w{i}" for i in range(32)],
+              np.maximum(rng.integers(1, 9, 32), 1).astype(np.int64))
+corpus = rng.integers(0, 32, 2000).astype(np.int32)
+tcfg = Config({"dim": "8", "window": "2", "negatives": "2",
+               "learning_rate": "0.1", "batch_size": "64", "subsample": "0",
+               "num_iters": "1", "use_native": "0"})
+tr = Word2VecTrainer(tcfg, mesh=None, corpus_ids=corpus, vocab=vocab)
+TrainLoop(tr, log_every=0).run(max_steps=5)
+barrier("end_of_training")
+print(f"process {idx} done", flush=True)
+"""
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--nproc", type=int, default=2)
+    p.add_argument("--port", default="29517")
+    p.add_argument("--logdir", default="/tmp/snails_cluster_test")
+    args = p.parse_args(argv)
+
+    os.makedirs(args.logdir, exist_ok=True)
+    script = os.path.join(args.logdir, "child.py")
+    with open(script, "w") as f:
+        f.write(_CHILD)
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    logs = []
+    for i in range(args.nproc):
+        log = open(os.path.join(args.logdir, f"proc{i}.log"), "w")
+        logs.append(log)
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, script, str(i), str(args.nproc), args.port],
+                stdout=log, stderr=subprocess.STDOUT, env=env, cwd=REPO,
+            )
+        )
+    deadline = time.time() + 300
+    rc = 0
+    for i, proc in enumerate(procs):
+        remaining = max(1, deadline - time.time())
+        try:
+            code = proc.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            code = -9
+        if code != 0:
+            rc = 1
+            print(f"process {i} FAILED (exit {code}); log:", file=sys.stderr)
+            sys.stderr.write(
+                open(os.path.join(args.logdir, f"proc{i}.log")).read()
+            )
+    for log in logs:
+        log.close()
+    print("cluster smoke test:", "PASS" if rc == 0 else "FAIL")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

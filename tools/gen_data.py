@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Synthetic training-data generator (``src/tools/gen-word2vec-data.py``
+parity, generalized to every model family).
+
+The reference emitted 10k records of 6-15 random int features on stdout.
+This tool covers the same word2vec shape plus the CTR families and a zipf
+text corpus for realistic benchmarks::
+
+    python tools/gen_data.py word2vec  --records 10000            > data.txt
+    python tools/gen_data.py text      --tokens 1000000 --vocab 71000 > text8ish.txt
+    python tools/gen_data.py ctr       --records 100000 --fields 13  > criteo-ish.txt
+    python tools/gen_data.py libsvm    --records 100000              > avazu-ish.txt
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def gen_word2vec(args, out):
+    """6-15 random int features per line (reference generator shape)."""
+    rng = np.random.default_rng(args.seed)
+    for _ in range(args.records):
+        n = rng.integers(6, 16)
+        out.write(" ".join(str(x) for x in rng.integers(0, 301, n)) + "\n")
+
+
+def gen_text(args, out):
+    """Zipf-distributed token stream, text8-like (one long line of words)."""
+    rng = np.random.default_rng(args.seed)
+    ranks = np.arange(1, args.vocab + 1, dtype=np.float64)
+    w = 1.0 / ranks**args.zipf
+    cdf = np.cumsum(w) / w.sum()
+    step = 1 << 20
+    written = 0
+    while written < args.tokens:
+        n = min(step, args.tokens - written)
+        ids = np.searchsorted(cdf, rng.random(n))
+        out.write(" ".join(f"w{i}" for i in ids))
+        out.write(" ")
+        written += n
+    out.write("\n")
+
+
+def gen_ctr(args, out):
+    """``label<TAB>f0<TAB>f1...`` multi-field categorical rows (Criteo-ish)."""
+    rng = np.random.default_rng(args.seed)
+    weights = rng.normal(size=args.fields)
+    for _ in range(args.records):
+        feats = rng.zipf(1.3, size=args.fields) % args.cardinality
+        score = (weights * (feats % 7 == 0)).sum()
+        label = int(rng.random() < 1 / (1 + np.exp(-score)))
+        out.write(str(label) + "\t" + "\t".join(str(int(f)) for f in feats) + "\n")
+
+
+def gen_libsvm(args, out):
+    """``label idx:val ...`` sparse rows (LR / FM input)."""
+    rng = np.random.default_rng(args.seed)
+    weights = {}
+    for _ in range(args.records):
+        n = rng.integers(5, 40)
+        idx = np.unique(rng.zipf(1.3, size=n) % args.cardinality)
+        score = sum(weights.setdefault(int(i), rng.normal() * 0.3) for i in idx)
+        label = int(rng.random() < 1 / (1 + np.exp(-score)))
+        out.write(
+            f"{label} " + " ".join(f"{int(i)}:1" for i in sorted(idx)) + "\n"
+        )
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("kind", choices=["word2vec", "text", "ctr", "libsvm"])
+    p.add_argument("--records", type=int, default=10000)
+    p.add_argument("--tokens", type=int, default=1_000_000)
+    p.add_argument("--vocab", type=int, default=71_000)
+    p.add_argument("--zipf", type=float, default=1.05)
+    p.add_argument("--fields", type=int, default=13)
+    p.add_argument("--cardinality", type=int, default=100_000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default="-")
+    args = p.parse_args(argv)
+    out = sys.stdout if args.out == "-" else open(args.out, "w")
+    {"word2vec": gen_word2vec, "text": gen_text, "ctr": gen_ctr,
+     "libsvm": gen_libsvm}[args.kind](args, out)
+    if out is not sys.stdout:
+        out.close()
+
+
+if __name__ == "__main__":
+    main()
