@@ -112,9 +112,10 @@ class Word2VecTrainer(Trainer):
         self.table_dtype = {
             "float32": jnp.float32, "bfloat16": jnp.bfloat16,
         }[cfg.get_str("table_dtype", "float32")]
-        # Fast path: packed [C, S, 128] tables + row-DMA kernels (single
-        # device; the mesh path keeps the 2-D pjit layout). See ops/rowdma.
-        self.packed = cfg.get_bool("packed", True) and mesh is None
+        # Fast path: packed [C, S, 128] tables + row-DMA kernels; with a
+        # mesh the same kernels run shard-local inside the shard_map
+        # collectives (transfer.pull/push_collective_packed). See ops/rowdma.
+        self.packed = cfg.get_bool("packed", True)
         # Negative sampling mode: "pool" shares a pool of `pool_size`
         # negatives across each `pool_block` consecutive pairs, scored on the
         # MXU and down-weighted by negatives/pool_size — same expected SGNS
@@ -169,6 +170,24 @@ class Word2VecTrainer(Trainer):
         if self.hash_keys:
             return hash_row(keys, self.capacity)
         return keys
+
+    # packed pull/push dispatch: single-device kernels, or shard_map
+    # collectives wrapping the same kernels when a mesh is present
+    def _ppull(self, table_state, rows):
+        if self.mesh is None:
+            return pull_packed(table_state, rows)
+        from swiftsnails_tpu.parallel.transfer import pull_collective_packed
+
+        return pull_collective_packed(self.mesh, table_state, rows)
+
+    def _ppush(self, table_state, rows, grads):
+        if self.mesh is None:
+            return push_packed(table_state, rows, grads, self.access, self.lr)
+        from swiftsnails_tpu.parallel.transfer import push_collective_packed
+
+        return push_collective_packed(
+            self.mesh, table_state, rows, grads, self.access, self.lr
+        )
 
     # -- data --------------------------------------------------------------
 
@@ -245,8 +264,8 @@ class Word2VecTrainer(Trainer):
         pool_rows = self._rows(pools.reshape(-1))
         out_rows = jnp.concatenate([pos_rows, pool_rows])
 
-        v = pull_packed(state.in_table, in_rows)
-        u = pull_packed(state.out_table, out_rows)
+        v = self._ppull(state.in_table, in_rows)
+        u = self._ppull(state.out_table, out_rows)
         u_pos = u[:b]
         pool = u[b:].reshape(nb, pn, *u.shape[1:])
 
@@ -265,8 +284,8 @@ class Word2VecTrainer(Trainer):
             v, u_pos, pool
         )
         du = jnp.concatenate([du_pos, dpool.reshape(-1, *dpool.shape[2:])])
-        in_table = push_packed(state.in_table, in_rows, dv, self.access, self.lr)
-        out_table = push_packed(state.out_table, out_rows, du, self.access, self.lr)
+        in_table = self._ppush(state.in_table, in_rows, dv)
+        out_table = self._ppush(state.out_table, out_rows, du)
         return W2VState(in_table, out_table), loss
 
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng):
@@ -277,8 +296,8 @@ class Word2VecTrainer(Trainer):
         in_rows = self._rows(centers)
         out_rows = self._rows(jnp.concatenate([contexts, negs.reshape(-1)]))
 
-        v = pull_packed(state.in_table, in_rows)
-        u = pull_packed(state.out_table, out_rows)
+        v = self._ppull(state.in_table, in_rows)
+        u = self._ppull(state.out_table, out_rows)
         u_pos = u[:b]
         u_neg = u[b:].reshape(b, k, *u.shape[1:])
 
@@ -293,8 +312,8 @@ class Word2VecTrainer(Trainer):
             v, u_pos, u_neg
         )
         du = jnp.concatenate([du_pos, du_neg.reshape(-1, *du_neg.shape[2:])])
-        in_table = push_packed(state.in_table, in_rows, dv, self.access, self.lr)
-        out_table = push_packed(state.out_table, out_rows, du, self.access, self.lr)
+        in_table = self._ppush(state.in_table, in_rows, dv)
+        out_table = self._ppush(state.out_table, out_rows, du)
         return W2VState(in_table, out_table), loss
 
     def train_step(self, state: W2VState, batch, rng):

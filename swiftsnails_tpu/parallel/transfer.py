@@ -110,3 +110,77 @@ def push_collective(
     )
     table, slots = fn(state.table, dict(state.slots), rows, grads)
     return TableState(table=table, slots=slots)
+
+
+# ------------------------------------------------------- packed variants ---
+#
+# Same two protocols over the packed [capacity, S, 128] layout: the local
+# shard work inside shard_map goes through the row-DMA kernel data plane
+# (ops/rowdma via store.pull_packed/push_packed) on TPU, XLA fallback on CPU.
+# The cross-device movement is identical to the 2-D path: pull assembles
+# full rows with one psum over `model`; push all_gathers the (rows, grads)
+# batch over `data` and every model shard updates only the rows it owns.
+
+
+def pull_collective_packed(mesh: Mesh, state, rows: jax.Array) -> jax.Array:
+    """Sharded packed gather -> [N, S, 128] (pull protocol)."""
+    from swiftsnails_tpu.parallel.store import PackedTableState, pull_packed
+
+    per = _rows_per_shard(state.capacity, mesh)
+
+    def local_pull(table_shard, rows_local):
+        m = lax.axis_index(MODEL_AXIS)
+        local_ids = rows_local - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        shard_state = PackedTableState(table=table_shard, slots={})
+        vals = pull_packed(shard_state, jnp.where(owned, local_ids, 0))
+        vals = jnp.where(owned[:, None, None], vals, 0)
+        return lax.psum(vals, MODEL_AXIS)
+
+    fn = shard_map(
+        local_pull,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS, None, None),
+        check_vma=False,
+    )
+    return fn(state.table, rows)
+
+
+def push_collective_packed(
+    mesh: Mesh,
+    state,
+    rows: jax.Array,
+    grads: jax.Array,
+    access: AccessMethod,
+    lr,
+):
+    """Sharded packed push: all_gather over data, row-DMA update of owned rows."""
+    from swiftsnails_tpu.parallel.store import PackedTableState, push_packed
+
+    per = _rows_per_shard(state.capacity, mesh)
+    slot_keys = sorted(state.slots.keys())
+
+    def local_push(table_shard, slot_shards, rows_local, grads_local):
+        rows_all = lax.all_gather(rows_local, DATA_AXIS, tiled=True)
+        grads_all = lax.all_gather(grads_local, DATA_AXIS, tiled=True)
+        m = lax.axis_index(MODEL_AXIS)
+        local_ids = rows_all - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        local_ids = jnp.where(owned, local_ids, per)  # unowned -> padding
+        grads_all = jnp.where(owned[:, None, None], grads_all, 0)
+        shard_state = PackedTableState(table=table_shard, slots=slot_shards)
+        new = push_packed(shard_state, local_ids, grads_all, access, lr)
+        return new.table, dict(new.slots)
+
+    shard_spec = P(MODEL_AXIS, None, None)
+    fn = shard_map(
+        local_push,
+        mesh=mesh,
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
+                  P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
+        check_vma=False,
+    )
+    table, slots = fn(state.table, dict(state.slots), rows, grads)
+    return PackedTableState(table=table, slots=slots)

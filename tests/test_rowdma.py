@@ -163,6 +163,65 @@ def test_word2vec_packed_pool_loss_decreases():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
+def test_packed_collectives_match_single_device():
+    """pull/push_collective_packed over a (2, 4) mesh == local packed path."""
+    from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+    from swiftsnails_tpu.parallel.transfer import (
+        pull_collective_packed,
+        push_collective_packed,
+    )
+
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+    access = SgdAccess()
+    state_m = create_packed_table(64, 200, access, mesh=mesh, seed=7)
+    state_1 = PackedTableState(
+        table=jnp.asarray(np.asarray(state_m.table)), slots={}
+    )
+    rng = np.random.default_rng(8)
+    rows = jnp.asarray(rng.integers(0, 64, 16).astype(np.int32))
+    grads = jnp.asarray(rng.random((16, 2, 128), dtype=np.float32))
+
+    got = pull_collective_packed(mesh, state_m, rows)
+    want = pull_packed(state_1, rows)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+    new_m = push_collective_packed(mesh, state_m, rows, grads, access, 0.1)
+    new_1 = push_packed(state_1, rows, grads, access, 0.1)
+    np.testing.assert_allclose(
+        np.asarray(new_m.table), np.asarray(new_1.table), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_word2vec_packed_mesh_trains():
+    """Full packed+pool train_step over a (2, 2) mesh runs and loss is finite."""
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh
+    from swiftsnails_tpu.utils.config import Config
+
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 2}, devices=jax.devices()[:4])
+    rng = np.random.default_rng(0)
+    vocab = Vocab([f"w{i}" for i in range(64)],
+                  np.maximum(rng.integers(1, 30, 64), 1).astype(np.int64))
+    cfg = Config({"dim": "16", "window": "2", "negatives": "2",
+                  "learning_rate": "0.1", "batch_size": "32", "subsample": "0",
+                  "num_iters": "1", "packed": "1", "pool_size": "8",
+                  "pool_block": "16"})
+    tr = Word2VecTrainer(cfg, mesh=mesh,
+                         corpus_ids=rng.integers(0, 64, 400).astype(np.int32),
+                         vocab=vocab)
+    assert tr.packed
+    state = tr.init_state()
+    batch = {
+        "centers": jax.device_put(rng.integers(0, 64, 32).astype(np.int32),
+                                  batch_sharding(mesh)),
+        "contexts": jax.device_put(rng.integers(0, 64, 32).astype(np.int32),
+                                   batch_sharding(mesh)),
+    }
+    state, m = jax.jit(tr.train_step)(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_word2vec_packed_export_and_neighbors(tmp_path):
     from swiftsnails_tpu.data.vocab import Vocab
     from swiftsnails_tpu.models.word2vec import Word2VecTrainer
