@@ -19,7 +19,9 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "libsnails.cpp")
-_SO = os.path.join(_DIR, "libsnails.so")
+# SSN_NATIVE_SO points at an alternate build (e.g. the ASan/TSan builds made
+# by tools/native_sanitize.sh); the default is built on demand next to _SRC.
+_SO = os.environ.get("SSN_NATIVE_SO") or os.path.join(_DIR, "libsnails.so")
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -28,6 +30,8 @@ _build_error: Optional[str] = None
 
 def _build() -> Optional[str]:
     """Compile the shared library if stale; returns error text or None."""
+    if os.environ.get("SSN_NATIVE_SO"):
+        return None if os.path.exists(_SO) else f"SSN_NATIVE_SO not found: {_SO}"
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return None
     cmd = [

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Evaluate an exported embedding file: neighbors, similarity, analogies.
+
+Works on the word2vec text format this framework exports
+(``<vocab> <dim>`` header then ``word v0 v1 ...`` lines — the same artifact
+shape the reference's servers dumped on terminate) so either framework's
+output can be inspected::
+
+    python tools/eval_embeddings.py vec.txt --neighbors king --topn 10
+    python tools/eval_embeddings.py vec.txt --sim cat dog
+    python tools/eval_embeddings.py vec.txt --analogy king man woman
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def load_embeddings(path):
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        header = f.readline().split()
+        n, dim = int(header[0]), int(header[1])
+        words, vecs = [], np.empty((n, dim), dtype=np.float32)
+        for i, line in enumerate(f):
+            parts = line.rstrip("\n").split(" ")
+            words.append(parts[0])
+            vecs[i] = np.asarray(parts[1 : dim + 1], dtype=np.float32)
+    norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+    vecs /= np.maximum(norms, 1e-9)
+    return words, {w: i for i, w in enumerate(words)}, vecs
+
+
+def nearest(vecs, q, topn, exclude=()):
+    sims = vecs @ q
+    order = np.argsort(-sims)
+    out = []
+    for i in order:
+        if i in exclude:
+            continue
+        out.append((int(i), float(sims[i])))
+        if len(out) >= topn:
+            break
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path")
+    p.add_argument("--neighbors", metavar="WORD")
+    p.add_argument("--sim", nargs=2, metavar=("W1", "W2"))
+    p.add_argument("--analogy", nargs=3, metavar=("A", "B", "C"),
+                   help="a : b :: c : ?  (b - a + c)")
+    p.add_argument("--topn", type=int, default=10)
+    args = p.parse_args(argv)
+
+    words, index, vecs = load_embeddings(args.path)
+    if args.neighbors:
+        i = index[args.neighbors]
+        for j, s in nearest(vecs, vecs[i], args.topn, exclude={i}):
+            print(f"{words[j]}\t{s:.4f}")
+    elif args.sim:
+        a, b = (index[w] for w in args.sim)
+        print(f"{float(vecs[a] @ vecs[b]):.4f}")
+    elif args.analogy:
+        a, b, c = (index[w] for w in args.analogy)
+        q = vecs[b] - vecs[a] + vecs[c]
+        q /= max(np.linalg.norm(q), 1e-9)
+        for j, s in nearest(vecs, q, args.topn, exclude={a, b, c}):
+            print(f"{words[j]}\t{s:.4f}")
+    else:
+        print(f"{len(words)} words, dim {vecs.shape[1]}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

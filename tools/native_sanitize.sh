@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Sanitizer pass for the native data pipeline (the reference ran valgrind
+# memcheck over its gtest binary, src/unitest/valgrind.sh; the modern analog
+# for libsnails.cpp is ASan/UBSan + TSan builds driving the same pytest
+# surface through ctypes).
+#
+#   tools/native_sanitize.sh [asan|tsan|both]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-both}"
+SRC=swiftsnails_tpu/data/native/libsnails.cpp
+OUT_DIR=$(mktemp -d /tmp/snails_sanitize.XXXXXX)
+trap 'rm -rf "$OUT_DIR"' EXIT
+
+run_mode() {
+  local name="$1"; shift
+  local flags="$*"
+  echo "=== $name build ==="
+  g++ -O1 -g -std=c++17 -shared -fPIC -pthread $flags \
+      -o "$OUT_DIR/libsnails_$name.so" "$SRC"
+  echo "=== $name: pytest tests/test_native.py ==="
+  # Preload the sanitizer runtime into python and point the bindings at the
+  # instrumented build.
+  local so="$OUT_DIR/libsnails_$name.so"
+  SSN_NATIVE_SO="$so" \
+  LD_PRELOAD="$(g++ -print-file-name=lib${name}.so)" \
+  ASAN_OPTIONS=detect_leaks=0 \
+  JAX_PLATFORMS=cpu \
+  python -m pytest tests/test_native.py -q
+}
+
+case "$MODE" in
+  asan) run_mode asan -fsanitize=address,undefined ;;
+  tsan) run_mode tsan -fsanitize=thread ;;
+  both) run_mode asan -fsanitize=address,undefined
+        run_mode tsan -fsanitize=thread ;;
+  *) echo "usage: $0 [asan|tsan|both]" >&2; exit 2 ;;
+esac
+echo "sanitizer pass OK"
