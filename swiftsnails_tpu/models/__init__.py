@@ -3,6 +3,7 @@ from swiftsnails_tpu.models.logreg import LogisticRegressionTrainer
 from swiftsnails_tpu.models.fm import FMTrainer, FFMTrainer
 from swiftsnails_tpu.models.widedeep import WideDeepTrainer
 from swiftsnails_tpu.models.sparse_base import CTRState, SparseCTRTrainer
+from swiftsnails_tpu.models.seqlm import SeqLMTrainer
 
 __all__ = [
     "Word2VecTrainer",
@@ -14,4 +15,5 @@ __all__ = [
     "WideDeepTrainer",
     "CTRState",
     "SparseCTRTrainer",
+    "SeqLMTrainer",
 ]
