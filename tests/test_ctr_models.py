@@ -69,6 +69,21 @@ def test_logreg_sharded_matches_quality(data):
     assert auc > 0.80, f"sharded logreg AUC {auc:.3f}"
 
 
+def test_widedeep_tensor_parallel_deep_side(data):
+    """dense_tp: 1 shards the MLP over the model axis and still learns."""
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+    trainer, state = run_model(
+        "widedeep", data, mesh=mesh, dense_tp="1", hidden_dims="64,32"
+    )
+    from swiftsnails_tpu.parallel.mesh import MODEL_AXIS as M
+
+    # col-parallel first hidden layer actually sharded over model axis
+    spec = state.dense["w0"].sharding.spec
+    assert tuple(spec) == (None, M), spec
+    auc = trainer.eval_auc(state, limit=4000)
+    assert auc > 0.80, f"TP widedeep AUC {auc:.3f}"
+
+
 def test_fm_captures_interactions():
     """FM must beat LR on data with planted pairwise interactions."""
     data_i = synth_ctr(12000, 4, 30, seed=5, interaction=True, noise=0.1)
