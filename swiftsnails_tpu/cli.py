@@ -95,6 +95,15 @@ _ROLE_NOTE = (
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # An explicit JAX_PLATFORMS must win even when a site plugin re-pins the
+    # platform after env processing (e.g. the axon TPU plugin's
+    # sitecustomize) — otherwise CPU-only runs try to grab the accelerator.
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         return 0

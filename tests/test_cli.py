@@ -1,0 +1,91 @@
+"""CLI role entry points end-to-end via subprocess (run_*.sh parity: binaries
+take ``-config <file>`` and produce the text param artifact)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(args, cwd):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "swiftsnails_tpu", *args],
+        capture_output=True, text=True, env=env, cwd=cwd, timeout=300,
+    )
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    path = tmp_path_factory.mktemp("cli") / "corpus.txt"
+    rng = np.random.default_rng(0)
+    words = [f"tok{i}" for i in range(30)]
+    path.write_text(" ".join(rng.choice(words, 3000)))
+    return path
+
+
+def test_cli_train_export_resume(tmp_path, corpus):
+    conf = tmp_path / "train.conf"
+    ckpt = tmp_path / "ckpt"
+    out = tmp_path / "vec.txt"
+    conf.write_text(
+        f"""# word2vec training config (reference key: value syntax)
+model: word2vec
+data: {corpus}
+dim: 8
+window: 2
+negatives: 2
+learning_rate: 0.1
+batch_size: 128
+num_iters: 2
+min_count: 1
+subsample: 0
+param_backup_root: {ckpt}
+param_backup_period: 3
+output: {out}
+log_every: 0
+"""
+    )
+    proc = _run_cli(["train", "-config", str(conf)], cwd=tmp_path)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert out.exists()
+    header = out.read_text().split("\n", 1)[0].split()
+    assert header[0] == "30"  # vocab size
+    assert os.path.isdir(ckpt)
+
+    # export role reads the checkpoint back
+    out2 = tmp_path / "vec2.txt"
+    proc = _run_cli(
+        ["export", "-config", str(conf), "-checkpoint", str(ckpt), "-out", str(out2)],
+        cwd=tmp_path,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert out2.exists()
+
+    # resume path: continues from the checkpoint without error
+    proc = _run_cli(["train", "-config", str(conf), "-resume", "1"], cwd=tmp_path)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_cli_models_and_role_notes(tmp_path):
+    proc = _run_cli(["models"], cwd=tmp_path)
+    assert proc.returncode == 0
+    for fam in ("word2vec", "logreg", "fm", "ffm", "widedeep", "seqlm"):
+        assert fam in proc.stdout
+    proc = _run_cli(["master"], cwd=tmp_path)
+    assert proc.returncode == 0
+    assert "no separate master role" in proc.stderr
+
+
+def test_cli_unknown_config_key_is_fatal(tmp_path, corpus):
+    """ConfigParser parity: dangling unknown lines crash by design."""
+    conf = tmp_path / "bad.conf"
+    conf.write_text("model word2vec\n")  # missing colon -> parse error
+    proc = _run_cli(["train", "-config", str(conf)], cwd=tmp_path)
+    assert proc.returncode != 0
