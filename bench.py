@@ -17,10 +17,33 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
+import signal
 import sys
 import time
 
 import numpy as np
+
+# Watchdog: a wedged accelerator grant can hang backend init indefinitely
+# (jax.devices() never returns). The driver needs one JSON line either way.
+BENCH_DEADLINE_S = int(os.environ.get("SSN_BENCH_DEADLINE_S", "1500"))
+
+
+def _deadline(signum, frame):
+    print(
+        json.dumps(
+            {
+                "metric": "word2vec_words_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "words/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"bench exceeded {BENCH_DEADLINE_S}s deadline "
+                         "(accelerator init hang?)",
+            }
+        ),
+        flush=True,
+    )
+    os._exit(1)
 
 
 # -- workload shape (north-star config) --------------------------------------
@@ -142,6 +165,8 @@ def measure_cpu_baseline(batches, pairs_per_token: float, emb_dim=DIM) -> float:
 
 
 def main():
+    signal.signal(signal.SIGALRM, _deadline)
+    signal.alarm(BENCH_DEADLINE_S)
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 
     rng = np.random.default_rng(1)
