@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Row-DMA kernel lab: hardware correctness + ns/row sweep.
+
+Run on the real chip to validate ops/rowdma kernels post-compile and pick
+block_rows / dtype:
+
+    python tools/kernel_lab.py [--quick]
+
+Timing uses the chain-and-fetch method (block_until_ready does not force
+execution through the axon tunnel; see docs/ARCHITECTURE.md).
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--vocab", type=int, default=1_000_000)
+    p.add_argument("--rows", type=int, default=98304)
+    p.add_argument("--dim", type=int, default=200)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.ops import rowdma
+
+    S = -(-args.dim // rowdma.ROW_LANES)
+    rng = np.random.default_rng(0)
+
+    def fresh(dtype):
+        t = rng.random((args.vocab, S, 128), dtype=np.float32)
+        return jnp.asarray(t, dtype=dtype)
+
+    rows_np = rng.integers(0, args.vocab, args.rows).astype(np.int32)
+    rows = jnp.asarray(rows_np)
+    uniq_np = rng.permutation(args.vocab)[: args.rows].astype(np.int32)
+    uniq = jnp.asarray(uniq_np)
+
+    # --- hardware correctness on small shapes first -----------------------
+    small_t = fresh(jnp.float32)[:4096]
+    small_rows = jnp.asarray(rng.integers(0, 4096, 1024).astype(np.int32))
+    got = rowdma.gather_rows(small_t, small_rows, block_rows=256)
+    want = small_t[small_rows]
+    err = float(jnp.abs(got - want).max())
+    print(f"gather correctness: max err {err}")
+    assert err == 0.0
+
+    small_uniq = jnp.asarray(
+        np.concatenate([rng.permutation(4096)[:1000], np.full(24, 4096)]).astype(np.int32)
+    )
+    deltas = jnp.asarray(rng.random((1024, S, 128), dtype=np.float32))
+    t2 = rowdma.scatter_add_rows(small_t + 0, small_uniq, deltas, block_rows=256)
+    want2 = np.asarray(small_t)
+    w = want2.copy()
+    for r, d in zip(np.asarray(small_uniq), np.asarray(deltas)):
+        if r < 4096:
+            w[r] += d
+    err2 = float(np.abs(np.asarray(t2) - w).max())
+    print(f"scatter correctness: max err {err2}")
+    assert err2 < 1e-5
+
+    # --- throughput sweep -------------------------------------------------
+    probe = jnp.zeros((8, 128), jnp.float32)
+
+    def bench(name, fn, n=20):
+        f = jax.jit(fn)
+        o = f(probe)
+        _ = float(o[0, 0])
+        t0 = time.perf_counter(); _ = float(o[0, 0])
+        fetch = time.perf_counter() - t0
+        o = probe
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = f(o)
+        _ = float(o[0, 0])
+        dt = (time.perf_counter() - t0 - fetch) / n * 1e3
+        print(f"{name}: {dt:.3f} ms  ({dt * 1e6 / args.rows:.1f} ns/row)")
+        return dt
+
+    dtypes = [jnp.float32] if args.quick else [jnp.float32, jnp.bfloat16]
+    blocks = [512] if args.quick else [256, 512, 1024]
+    for dtype in dtypes:
+        table = fresh(dtype)
+        for br in blocks:
+            bench(
+                f"gather {args.rows} rows dtype={dtype.__name__} R={br}",
+                lambda p, br=br, table=table: p
+                + rowdma.gather_rows(
+                    table, rows + p[0, 0].astype(jnp.int32), block_rows=br
+                )[:8, 0, :].astype(jnp.float32),
+            )
+        # XLA reference
+        bench(
+            f"gather {args.rows} XLA dtype={dtype.__name__}",
+            lambda p, table=table: p
+            + table.at[rows + p[0, 0].astype(jnp.int32)]
+            .get(mode="promise_in_bounds")[:8, 0, :]
+            .astype(jnp.float32),
+        )
+
+        deltas_big = jnp.asarray(
+            rng.random((args.rows, S, 128), dtype=np.float32) * 1e-9, dtype=dtype
+        )
+        for br in blocks:
+            def scat(p, br=br, table=table):
+                t = rowdma.scatter_add_rows(table + p[0, 0] * 0, uniq, deltas_big, block_rows=br)
+                return p + t[0, 0, :].astype(jnp.float32)[None, :]
+            bench(f"scatter {args.rows} unique dtype={dtype.__name__} R={br}", scat)
+
+        def scat_xla(p, table=table):
+            t = (table + p[0, 0] * 0).at[uniq].add(deltas_big, mode="drop")
+            return p + t[0, 0, :].astype(jnp.float32)[None, :]
+        bench(f"scatter {args.rows} XLA dtype={dtype.__name__}", scat_xla)
+
+
+if __name__ == "__main__":
+    main()

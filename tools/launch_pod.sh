@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Multi-host launcher (the reference's Hadoop-Streaming deploy scripts'
+# equivalent: hadoop-server.sh / hadoop-worker.sh shipped role binaries to
+# reducers and fed data splits on stdin; on a TPU pod every host runs the
+# same SPMD `train` role and data splits by process index).
+#
+#   tools/launch_pod.sh <hosts-file> <config> [extra -key value overrides...]
+#
+# hosts-file: one hostname per line; host 0 is the coordinator. Each host
+# needs this repo at the same path and passwordless ssh. For GKE/xpk-style
+# managed launches, point the container entrypoint at
+#   python -m swiftsnails_tpu train -config <config>
+# and let the platform set the coordinator env; initialize_cluster reads
+# master_addr/expected_node_num from the config either way.
+set -euo pipefail
+
+HOSTS_FILE="$1"; shift
+CONFIG="$1"; shift
+PORT="${SNAILS_COORD_PORT:-29500}"
+
+mapfile -t HOSTS < "$HOSTS_FILE"
+N="${#HOSTS[@]}"
+COORD="${HOSTS[0]}:$PORT"
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+
+echo "launching $N processes; coordinator $COORD" >&2
+PIDS=()
+for i in "${!HOSTS[@]}"; do
+  HOST="${HOSTS[$i]}"
+  CMD="cd $REPO_DIR && python -m swiftsnails_tpu train -config $CONFIG \
+       -master_addr $COORD -expected_node_num $N $*"
+  if [[ "$HOST" == "localhost" || "$HOST" == "127.0.0.1" ]]; then
+    bash -c "$CMD" &
+  else
+    ssh -o BatchMode=yes "$HOST" "$CMD" &
+  fi
+  PIDS+=($!)
+done
+
+RC=0
+for pid in "${PIDS[@]}"; do
+  wait "$pid" || RC=1
+done
+exit $RC
