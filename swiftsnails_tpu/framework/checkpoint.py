@@ -37,15 +37,39 @@ def _step_dir(root: str, step: int) -> str:
     return os.path.join(os.path.abspath(root), f"step_{step}")
 
 
-def save_checkpoint(root: str, state: Any, step: int) -> str:
-    """Write a sharded checkpoint for ``step`` under ``root`` (param_backup parity)."""
-    import orbax.checkpoint as ocp
+_async_ckptr = None
 
+
+def _checkpointer():
+    global _async_ckptr
+    if _async_ckptr is None:
+        import orbax.checkpoint as ocp
+
+        _async_ckptr = ocp.StandardCheckpointer()
+    return _async_ckptr
+
+
+def save_checkpoint(root: str, state: Any, step: int, wait: bool = True) -> str:
+    """Write a sharded checkpoint for ``step`` under ``root`` (param_backup parity).
+
+    ``wait=False`` returns once device buffers are snapshotted and lets the
+    write proceed in the background (the periodic-save path in TrainLoop);
+    the next save or :func:`wait_for_checkpoints` joins it. The reference
+    blocked its push handlers while dumping shards to text
+    (``server/init.h:128-149``) — async here means training never stalls.
+    """
     path = _step_dir(root, step)
-    ckptr = ocp.StandardCheckpointer()
+    ckptr = _checkpointer()
     ckptr.save(path, state, force=True)
-    ckptr.wait_until_finished()
+    if wait:
+        ckptr.wait_until_finished()
     return path
+
+
+def wait_for_checkpoints() -> None:
+    """Join any in-flight async checkpoint writes."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
 
 
 def latest_step(root: str) -> Optional[int]:
@@ -68,12 +92,13 @@ def restore_checkpoint(root: str, state_template: Any, step: Optional[int] = Non
     """
     import orbax.checkpoint as ocp
 
+    wait_for_checkpoints()  # never read past an in-flight async save
     if step is None:
         step = latest_step(root)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {root}")
     path = _step_dir(root, step)
-    ckptr = ocp.StandardCheckpointer()
+    ckptr = _checkpointer()
     abstract = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
         state_template,

@@ -157,7 +157,10 @@ class TrainLoop:
         if checkpoint_fn is None and self.backup_root:
             from swiftsnails_tpu.framework.checkpoint import save_checkpoint
 
-            checkpoint_fn = lambda state, step: save_checkpoint(self.backup_root, state, step)
+            # async periodic saves: training continues while shards write
+            checkpoint_fn = lambda state, step: save_checkpoint(
+                self.backup_root, state, step, wait=False
+            )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
         self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
@@ -213,4 +216,8 @@ class TrainLoop:
         if step % max(self.log_every, 1) != 0 or not self.log_every:
             host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
             self.metrics.flush_window(step=step, **host)
+        if self.checkpoint_fn is not None:
+            from swiftsnails_tpu.framework.checkpoint import wait_for_checkpoints
+
+            wait_for_checkpoints()
         return state

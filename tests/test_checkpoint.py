@@ -115,3 +115,34 @@ def test_trainloop_checkpoint_and_resume(tmp_path):
         np.asarray(restore_checkpoint(root, trainer2.init_state()).in_table.table),
     )
     assert state2 is not None
+
+
+def test_async_save_then_restore(tmp_path):
+    """wait=False saves must be joinable and restorable."""
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.framework.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+        wait_for_checkpoints,
+    )
+
+    root = str(tmp_path / "async")
+    state = {"w": jnp.arange(12.0).reshape(3, 4)}
+    save_checkpoint(root, state, 3, wait=False)
+    wait_for_checkpoints()
+    got = restore_checkpoint(root, {"w": jnp.zeros((3, 4))})
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(state["w"]))
+
+
+def test_prefetcher_propagates_errors():
+    from swiftsnails_tpu.framework.trainer import _Prefetcher
+
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    pf = _Prefetcher(iter(gen()), depth=2)
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
