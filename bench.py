@@ -18,18 +18,21 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import json
 import os
-import signal
 import sys
+import threading
 import time
 
 import numpy as np
 
 # Watchdog: a wedged accelerator grant can hang backend init indefinitely
 # (jax.devices() never returns). The driver needs one JSON line either way.
+# A watchdog THREAD (not SIGALRM) because the hang is inside a single native
+# PJRT call — a Python signal handler would never get to run on the blocked
+# main thread, but a daemon thread prints and exits regardless.
 BENCH_DEADLINE_S = int(os.environ.get("SSN_BENCH_DEADLINE_S", "1500"))
 
 
-def _deadline(signum, frame):
+def _deadline():
     print(
         json.dumps(
             {
@@ -165,8 +168,9 @@ def measure_cpu_baseline(batches, pairs_per_token: float, emb_dim=DIM) -> float:
 
 
 def main():
-    signal.signal(signal.SIGALRM, _deadline)
-    signal.alarm(BENCH_DEADLINE_S)
+    watchdog = threading.Timer(BENCH_DEADLINE_S, _deadline)
+    watchdog.daemon = True  # don't keep the process alive after success
+    watchdog.start()
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 
     rng = np.random.default_rng(1)

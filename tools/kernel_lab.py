@@ -91,14 +91,15 @@ def main(argv=None):
                 f"gather {args.rows} rows dtype={dtype.__name__} R={br}",
                 lambda p, br=br, table=table: p
                 + rowdma.gather_rows(
-                    table, rows + p[0, 0].astype(jnp.int32), block_rows=br
+                    table, (rows + p[0, 0].astype(jnp.int32)) % args.vocab,
+                    block_rows=br,
                 )[:8, 0, :].astype(jnp.float32),
             )
         # XLA reference
         bench(
             f"gather {args.rows} XLA dtype={dtype.__name__}",
             lambda p, table=table: p
-            + table.at[rows + p[0, 0].astype(jnp.int32)]
+            + table.at[(rows + p[0, 0].astype(jnp.int32)) % args.vocab]
             .get(mode="promise_in_bounds")[:8, 0, :]
             .astype(jnp.float32),
         )

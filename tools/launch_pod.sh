@@ -18,7 +18,8 @@ HOSTS_FILE="$1"; shift
 CONFIG="$1"; shift
 PORT="${SNAILS_COORD_PORT:-29500}"
 
-mapfile -t HOSTS < "$HOSTS_FILE"
+# skip blank lines and comments in the hosts file
+mapfile -t HOSTS < <(grep -vE '^\s*(#|$)' "$HOSTS_FILE")
 N="${#HOSTS[@]}"
 COORD="${HOSTS[0]}:$PORT"
 REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
@@ -27,8 +28,12 @@ echo "launching $N processes; coordinator $COORD" >&2
 PIDS=()
 for i in "${!HOSTS[@]}"; do
   HOST="${HOSTS[$i]}"
-  CMD="cd $REPO_DIR && python -m swiftsnails_tpu train -config $CONFIG \
-       -master_addr $COORD -expected_node_num $N $*"
+  # printf %q so paths/overrides with spaces survive the remote shell
+  EXTRA=""
+  if (( $# > 0 )); then EXTRA="$(printf '%q ' "$@")"; fi
+  CMD="cd $(printf '%q' "$REPO_DIR") && python -m swiftsnails_tpu train \
+       -config $(printf '%q' "$CONFIG") \
+       -master_addr $COORD -expected_node_num $N $EXTRA"
   if [[ "$HOST" == "localhost" || "$HOST" == "127.0.0.1" ]]; then
     bash -c "$CMD" &
   else
