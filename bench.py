@@ -76,7 +76,7 @@ def synth_corpus(n_tokens: int, vocab: int, seed: int = 0) -> np.ndarray:
     return np.searchsorted(cdf, u).astype(np.int32)
 
 
-def measure_tpu(counts: np.ndarray, batches, pairs_per_token: float) -> float:
+def _measure_tpu_config(counts, batches, pairs_per_token, overrides):
     """Timed via a data-dependent chain + scalar fetch.
 
     ``jax.block_until_ready`` does not force execution through the axon
@@ -91,24 +91,19 @@ def measure_tpu(counts: np.ndarray, batches, pairs_per_token: float) -> float:
     from swiftsnails_tpu.models.word2vec import Word2VecTrainer
     from swiftsnails_tpu.utils.config import Config
 
-    cfg = Config(
-        {
-            "dim": str(DIM),
-            "window": str(WINDOW),
-            "negatives": str(NEGATIVES),
-            "learning_rate": "0.025",
-            "batch_size": str(BATCH),
-            "subsample": "0",
-            "num_iters": "1",
-            # fast path: packed tables + row-DMA kernels + pooled negatives
-            "packed": "1",
-            "neg_mode": "pool",
-            "pool_size": str(POOL_SIZE),
-            "pool_block": str(POOL_BLOCK),
-            "steps_per_call": str(STEPS_PER_CALL),
-            "table_dtype": TABLE_DTYPE,
-        }
-    )
+    conf = {
+        "dim": str(DIM),
+        "window": str(WINDOW),
+        "negatives": str(NEGATIVES),
+        "learning_rate": "0.025",
+        "batch_size": str(BATCH),
+        "subsample": "0",
+        "num_iters": "1",
+        "steps_per_call": str(STEPS_PER_CALL),
+        "table_dtype": TABLE_DTYPE,
+    }
+    conf.update(overrides)
+    cfg = Config(conf)
     vocab = Vocab([f"w{i}" for i in range(VOCAB)], counts)
     trainer = Word2VecTrainer(
         cfg, mesh=None, corpus_ids=np.zeros(2, np.int32), vocab=vocab
@@ -133,6 +128,23 @@ def measure_tpu(counts: np.ndarray, batches, pairs_per_token: float) -> float:
     dt = time.perf_counter() - t0 - fetch_latency
     pairs_per_sec = MEASURE_STEPS * STEPS_PER_CALL * BATCH / dt
     return pairs_per_sec / pairs_per_token
+
+
+def measure_tpu(counts, batches, pairs_per_token):
+    """Fast path (packed row-DMA kernels + pooled negatives), falling back
+    to the dense XLA path if the kernel path fails on this hardware —
+    the bench must produce a number either way."""
+    fast = {"packed": "1", "neg_mode": "pool",
+            "pool_size": str(POOL_SIZE), "pool_block": str(POOL_BLOCK)}
+    try:
+        return _measure_tpu_config(counts, batches, pairs_per_token, fast), "packed+pool"
+    except Exception as e:  # Mosaic/compile failure -> dense fallback
+        print(f"bench: packed path failed ({type(e).__name__}: {e}); "
+              "falling back to dense", file=sys.stderr)
+        wps = _measure_tpu_config(
+            counts, batches, pairs_per_token, {"packed": "0"}
+        )
+        return wps, "dense-fallback"
 
 
 def measure_cpu_baseline(batches, pairs_per_token: float, emb_dim=DIM) -> float:
@@ -184,7 +196,7 @@ def main():
     batches = list(batch_stream(centers, contexts, macro, rng))[:8]
     batches = [b for b in batches if b["centers"].shape[0] == macro]
 
-    words_per_sec = measure_tpu(counts, batches, pairs_per_token)
+    words_per_sec, path = measure_tpu(counts, batches, pairs_per_token)
     flat = [
         {k: v[i * BATCH : (i + 1) * BATCH] for k, v in b.items()}
         for b in batches[:2]
@@ -202,6 +214,7 @@ def main():
                 "vs_baseline": round(words_per_sec / baseline_wps, 3),
                 "baseline_words_per_sec_8node_cpu": round(baseline_wps, 1),
                 "pairs_per_token": round(pairs_per_token, 3),
+                "path": path,
                 "config": {
                     "vocab": VOCAB,
                     "dim": DIM,
