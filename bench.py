@@ -131,20 +131,25 @@ def _measure_tpu_config(counts, batches, pairs_per_token, overrides):
 
 
 def measure_tpu(counts, batches, pairs_per_token):
-    """Fast path (packed row-DMA kernels + pooled negatives), falling back
-    to the dense XLA path if the kernel path fails on this hardware —
-    the bench must produce a number either way."""
-    fast = {"packed": "1", "neg_mode": "pool",
+    """Try the fastest path first, fall back on kernel-compile failure —
+    the bench must produce a number on any hardware state."""
+    pool = {"packed": "1", "neg_mode": "pool",
             "pool_size": str(POOL_SIZE), "pool_block": str(POOL_BLOCK)}
-    try:
-        return _measure_tpu_config(counts, batches, pairs_per_token, fast), "packed+pool"
-    except Exception as e:  # Mosaic/compile failure -> dense fallback
-        print(f"bench: packed path failed ({type(e).__name__}: {e}); "
-              "falling back to dense", file=sys.stderr)
-        wps = _measure_tpu_config(
-            counts, batches, pairs_per_token, {"packed": "0"}
-        )
-        return wps, "dense-fallback"
+    paths = [
+        ("fused-hogwild", {**pool, "fused": "1"}),
+        ("packed+pool", pool),
+        ("dense-fallback", {"packed": "0"}),
+    ]
+    last_err = None
+    for name, overrides in paths:
+        try:
+            wps = _measure_tpu_config(counts, batches, pairs_per_token, overrides)
+            return wps, name
+        except Exception as e:  # Mosaic/compile failure -> next path
+            print(f"bench: {name} path failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+            last_err = e
+    raise last_err
 
 
 def measure_cpu_baseline(batches, pairs_per_token: float, emb_dim=DIM) -> float:

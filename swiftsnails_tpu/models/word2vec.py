@@ -127,6 +127,14 @@ class Word2VecTrainer(Trainer):
             raise ValueError("neg_mode: pool requires packed tables (packed: 1)")
         self.pool_size = cfg.get_int("pool_size", 64)
         self.pool_block = cfg.get_int("pool_block", 512)
+        # fused: 1 -> the single-kernel hogwild substep (ops/fused_sgns.py;
+        # reference async-SGD semantics). Requires packed+pool, single device.
+        self.fused = (
+            cfg.get_bool("fused", False)
+            and self.packed
+            and self.neg_mode == "pool"
+            and mesh is None
+        )
         # scan this many optimizer substeps per dispatch (amortizes host->TPU
         # dispatch latency). NOTE: TrainLoop steps/checkpoints count
         # dispatches, so substeps scale throughput, not the step counter.
@@ -288,6 +296,35 @@ class Word2VecTrainer(Trainer):
         out_table = self._ppush(state.out_table, out_rows, du)
         return W2VState(in_table, out_table), loss
 
+    def _substep_fused(self, state: W2VState, centers, contexts, rng):
+        """Single-kernel hogwild substep (see ops/fused_sgns.py)."""
+        from swiftsnails_tpu.ops import rowdma
+        from swiftsnails_tpu.ops.fused_sgns import fused_sgns_step
+
+        b = centers.shape[0]
+        pb = min(self.pool_block, b)
+        while b % pb:
+            pb -= 1
+        nb = b // pb
+        pn = self.pool_size
+        pools = alias_sample(self.neg_alias, rng, (nb, pn))
+        in_t, out_t, loss = fused_sgns_step(
+            state.in_table.table,
+            state.out_table.table,
+            self._rows(centers),
+            self._rows(contexts),
+            self._rows(pools.reshape(-1)),
+            lr=self.lr,
+            lam=self.negatives / pn,
+            pairs_per_block=pb,
+            pool_size=pn,
+            interpret=not rowdma.on_tpu(),
+        )
+        return W2VState(
+            PackedTableState(table=in_t, slots=state.in_table.slots),
+            PackedTableState(table=out_t, slots=state.out_table.slots),
+        ), loss
+
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng):
         """Packed tables with reference-faithful per-pair K negatives."""
         b = centers.shape[0]
@@ -322,7 +359,9 @@ class Word2VecTrainer(Trainer):
         n = centers.shape[0]
         t = max(n // self.batch_size, 1)
         b = n // t
-        if self.packed:
+        if self.fused:
+            substep = self._substep_fused
+        elif self.packed:
             substep = (
                 self._substep_packed
                 if self.neg_mode == "pool"

@@ -1,0 +1,121 @@
+"""Fused SGNS kernel vs an exact sequential reference (interpret mode).
+
+In interpret mode the grid is sequential, so the kernel's result equals
+"apply blocks in order; within a block gather first, then write V rows in
+index order, then U rows, then pool rows (later write wins)" — which this
+test implements directly in numpy.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.ops.fused_sgns import fused_sgns_step
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def reference_fused(in_t, out_t, in_rows, pos_rows, pool_rows, lr, lam, p, pn):
+    """Models the kernel's double-buffered schedule: block b's reads are
+    issued before block b-1's writes land, so they see the table state after
+    writes of blocks <= b-2 (the one-block hogwild staleness window)."""
+    in_t = in_t.copy()
+    out_t = out_t.copy()
+    b = len(in_rows)
+    nblocks = b // p
+    inv_b = 1.0 / b
+    total_loss = 0.0
+    d = in_t.shape[1] * in_t.shape[2]
+    snap_in, snap_out = in_t.copy(), out_t.copy()  # writes <= b-2 view
+    for blk in range(nblocks):
+        ir = in_rows[blk * p : (blk + 1) * p]
+        pr = pos_rows[blk * p : (blk + 1) * p]
+        qr = pool_rows[blk * pn : (blk + 1) * pn]
+        V = snap_in[ir].reshape(p, d).astype(np.float32)
+        U = snap_out[pr].reshape(p, d).astype(np.float32)
+        Q = snap_out[qr].reshape(pn, d).astype(np.float32)
+        snap_in, snap_out = in_t.copy(), out_t.copy()  # now writes <= blk-1
+        pos = (V * U).sum(1)
+        neg = V @ Q.T
+        g_pos = (_sigmoid(pos) - 1.0) * inv_b
+        g_neg = lam * inv_b * _sigmoid(neg)
+        dV = g_pos[:, None] * U + g_neg @ Q
+        dU = g_pos[:, None] * V
+        dQ = g_neg.T @ V
+        shape = in_t.shape[1:]
+        for j in range(p):  # V writes, later index wins
+            in_t[ir[j]] = (V[j] - lr * dV[j]).reshape(shape)
+        for j in range(p):  # then U writes
+            out_t[pr[j]] = (U[j] - lr * dU[j]).reshape(shape)
+        for q in range(pn):  # then pool writes
+            out_t[qr[q]] = (Q[q] - lr * dQ[q]).reshape(shape)
+        total_loss += -(
+            np.log(_sigmoid(pos)).sum() + lam * np.log(_sigmoid(-neg)).sum()
+        ) * inv_b
+    return in_t, out_t, total_loss
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fused_matches_sequential_reference(seed):
+    rng = np.random.default_rng(seed)
+    C, S, L = 64, 2, 128
+    B, P, PN = 32, 8, 4
+    in_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    out_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    # include duplicates on purpose (hogwild semantics must still match the
+    # sequential reference under interpret's serial execution)
+    in_rows = rng.integers(0, C, B).astype(np.int32)
+    pos_rows = rng.integers(0, C, B).astype(np.int32)
+    pool_rows = rng.integers(0, C, (B // P) * PN).astype(np.int32)
+    lr, lam = 0.05, 0.625
+
+    want_in, want_out, want_loss = reference_fused(
+        in_t, out_t, in_rows, pos_rows, pool_rows, lr, lam, P, PN
+    )
+    got_in, got_out, got_loss = fused_sgns_step(
+        jnp.asarray(in_t), jnp.asarray(out_t),
+        jnp.asarray(in_rows), jnp.asarray(pos_rows), jnp.asarray(pool_rows),
+        lr=lr, lam=lam, pairs_per_block=P, pool_size=PN, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_in), want_in, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_out), want_out, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
+
+
+def test_fused_trains_toy_corpus():
+    """End to end through the trainer config (fused: 1), CPU interpret."""
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    vocab_size = 32
+    counts = np.maximum(rng.integers(1, 20, vocab_size), 1).astype(np.int64)
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)], counts)
+    base = np.repeat(np.arange(8), 60) % vocab_size
+    corpus = ((base + rng.integers(0, 2, base.size)) % vocab_size).astype(np.int32)
+    cfg = Config({
+        "dim": "16", "window": "2", "negatives": "2", "learning_rate": "0.1",
+        "batch_size": "64", "subsample": "0", "num_iters": "20",
+        "pool_size": "8", "pool_block": "16", "packed": "1", "fused": "1",
+        "use_native": "0",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None, corpus_ids=corpus, vocab=vocab)
+    assert tr.fused
+    state = tr.init_state()
+    step = jax.jit(tr.train_step)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i, batch in enumerate(tr.batches()):
+        if batch["centers"].shape[0] % 64:
+            continue
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(key, i))
+        losses.append(float(m["loss"]))
+        if len(losses) >= 40:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
