@@ -98,12 +98,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # An explicit JAX_PLATFORMS must win even when a site plugin re-pins the
     # platform after env processing (e.g. the axon TPU plugin's
     # sitecustomize) — otherwise CPU-only runs try to grab the accelerator.
-    import os
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    repin_from_env()
     if not argv or argv[0] in ("-h", "--help", "help"):
         print(__doc__)
         return 0

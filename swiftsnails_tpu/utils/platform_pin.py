@@ -1,0 +1,63 @@
+"""Pin JAX to the virtual-CPU host platform (the axon-override workaround).
+
+The TPU plugin in this image registers via ``sitecustomize`` and re-pins
+``jax_platforms`` AFTER env vars are read, so forcing CPU requires both the
+env vars (before jax's backend initializes) and a ``jax.config.update`` after
+``import jax``.  Used by ``tests/conftest.py``, ``__graft_entry__.py`` and the
+CLI — one copy so the workaround can't drift (round-1 MULTICHIP rc=124 was
+exactly such a drift).
+
+This module must stay importable without importing jax.
+"""
+
+import os
+import re
+
+
+def pin_cpu(n_devices: int = 8) -> None:
+    """Set env so a *not-yet-initialized* jax picks the virtual CPU platform.
+
+    Must run before jax creates its backend. If ``XLA_FLAGS`` already forces a
+    host device count, it is raised (never lowered) to ``n_devices``.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+    m = pat.search(flags)
+    if m:
+        count = max(int(m.group(1)), n_devices)
+        flags = pat.sub(f"--xla_force_host_platform_device_count={count}", flags)
+    else:
+        flags = f"{flags} --xla_force_host_platform_device_count={n_devices}".strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def repin_after_import(n_devices: int) -> None:
+    """Override the sitecustomize re-pin; verify enough CPU devices exist.
+
+    Call right after ``import jax``. Raises if the backend already
+    initialized with fewer devices (the env vars came too late).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    have = len(jax.devices("cpu"))
+    if have < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} virtual CPU devices but jax initialized with "
+            f"{have} — backend was created before pin_cpu(); run in a fresh "
+            "process"
+        )
+
+
+def repin_from_env() -> None:
+    """Honor an explicit ``JAX_PLATFORMS`` over the sitecustomize re-pin.
+
+    The CLI variant: doesn't force CPU — it re-asserts whatever platform the
+    user exported (no-op if unset). Call right after ``import jax``.
+    """
+    explicit = os.environ.get("JAX_PLATFORMS")
+    if explicit:
+        import jax
+
+        jax.config.update("jax_platforms", explicit)

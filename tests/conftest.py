@@ -9,20 +9,13 @@ pjit/shard_map code path (SURVEY §4).
 Env vars must be set before jax initializes its backends, hence this conftest.
 """
 
-import os
+from swiftsnails_tpu.utils.platform_pin import pin_cpu, repin_after_import
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # the shell pins a TPU platform; tests run on CPU
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+pin_cpu(8)  # the shell pins a TPU platform; tests run on the virtual CPU mesh
 
 import jax  # noqa: E402
 
-# The axon TPU plugin (sitecustomize) re-pins jax_platforms after env vars are
-# read; override it before any backend initializes.
-jax.config.update("jax_platforms", "cpu")
+repin_after_import(8)
 
 import pytest  # noqa: E402
 
