@@ -84,6 +84,14 @@ def _load():
         ]
         lib.ssn_read_ctr.restype = c.c_int64
         lib.ssn_read_ctr.argtypes = [c.c_char_p, c.c_int, c.c_void_p, c.c_void_p, c.c_int64]
+        lib.ssn_neg_table_build.restype = c.c_void_p
+        lib.ssn_neg_table_build.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+        lib.ssn_neg_table_free.argtypes = [c.c_void_p]
+        lib.ssn_sgns_train.restype = c.c_double
+        lib.ssn_sgns_train.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int, c.c_void_p, c.c_void_p,
+            c.c_int64, c.c_int, c.c_float, c.c_void_p, c.c_uint64,
+        ]
         lib.ssn_prefetch_open.restype = c.c_void_p
         lib.ssn_prefetch_open.argtypes = [
             c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_int, c.c_int, c.c_uint64,
@@ -236,6 +244,61 @@ def read_ctr(path: str, num_fields: int) -> Tuple[np.ndarray, np.ndarray]:
     if got < 0:
         raise RuntimeError("file changed size during read")
     return labels[:got], feats[:got]
+
+
+def sgns_train(
+    syn0: np.ndarray,
+    syn1: np.ndarray,
+    centers: np.ndarray,
+    contexts: np.ndarray,
+    counts: np.ndarray,
+    negatives: int = 5,
+    lr: float = 0.025,
+    table_size: int = 1 << 22,
+    seed: int = 0,
+) -> float:
+    """Run the compiled single-node SGNS worker loop in place.
+
+    Returns elapsed seconds for the training loop (excluding the one-time
+    negative-table build). ``syn0``/``syn1`` are updated in place — this is
+    bench.py's calibrated per-node CPU parameter-server baseline.
+    """
+    lib = _require()
+    # The C loop trusts its pointers; validate everything that could write
+    # out of bounds (real raises, not asserts — must survive python -O).
+    for name, a in (("syn0", syn0), ("syn1", syn1)):
+        if a.dtype != np.float32 or not a.flags.c_contiguous or a.ndim != 2:
+            raise ValueError(f"{name} must be a C-contiguous float32 matrix")
+    if syn0.shape[1] != syn1.shape[1]:
+        raise ValueError(f"dim mismatch: {syn0.shape} vs {syn1.shape}")
+    centers = np.ascontiguousarray(centers, dtype=np.int32)
+    contexts = np.ascontiguousarray(contexts, dtype=np.int32)
+    counts = np.ascontiguousarray(counts, dtype=np.int64)
+    if centers.shape != contexts.shape:
+        raise ValueError("centers/contexts length mismatch")
+    if centers.size and (
+        centers.min() < 0 or centers.max() >= syn0.shape[0]
+    ):
+        raise ValueError("center id out of range for syn0")
+    if contexts.size and (
+        contexts.min() < 0 or contexts.max() >= syn1.shape[0]
+    ):
+        raise ValueError("context id out of range for syn1")
+    # negative-table targets index syn1 rows in [0, counts.size)
+    if counts.size > syn1.shape[0]:
+        raise ValueError("counts longer than syn1 rows")
+    table = lib.ssn_neg_table_build(_ptr(counts), counts.size, table_size)
+    if not table:
+        raise ValueError("empty vocab for negative table")
+    try:
+        return float(
+            lib.ssn_sgns_train(
+                _ptr(syn0), _ptr(syn1), syn0.shape[1], _ptr(centers),
+                _ptr(contexts), centers.size, negatives, lr, table, seed,
+            )
+        )
+    finally:
+        lib.ssn_neg_table_free(table)
 
 
 class PairPrefetcher:

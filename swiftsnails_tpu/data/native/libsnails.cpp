@@ -18,6 +18,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <condition_variable>
 #include <cstdint>
@@ -266,6 +267,99 @@ extern "C" int64_t ssn_read_ctr(const char* path, int num_fields, float* labels_
     p = line_end + 1;
   }
   return row;
+}
+
+// --------------------------------------------------------- sgns baseline ---
+//
+// Compiled single-node SGNS worker loop for bench.py's CPU baseline: the
+// reference's worker hot path was C++ (app layer absent from the snapshot;
+// contract at src/core/framework/SwiftWorker.h:88-124), so the "8-node CPU
+// parameter server" baseline must be calibrated from compiled code, not
+// numpy (np.add.at is 10-50x slower than a C loop and would inflate
+// vs_baseline). Shape follows the classic word2vec.c hot loop: sigmoid
+// lookup table, unigram^0.75 negative table, per-pair gather -> sigmoid ->
+// scatter-update.
+
+namespace {
+constexpr int kExpTableSize = 1000;
+constexpr float kMaxExp = 6.0f;
+
+struct NegTable {
+  std::vector<int32_t> table;
+};
+}  // namespace
+
+extern "C" void* ssn_neg_table_build(const int64_t* counts, int64_t vocab,
+                                     int64_t table_size) {
+  if (vocab <= 0 || table_size <= 0) return nullptr;
+  NegTable* t = new NegTable();
+  t->table.resize((size_t)table_size);
+  double total = 0.0;
+  for (int64_t i = 0; i < vocab; ++i) total += std::pow((double)counts[i], 0.75);
+  int64_t w = 0;
+  double cum = std::pow((double)counts[0], 0.75) / total;
+  for (int64_t a = 0; a < table_size; ++a) {
+    t->table[(size_t)a] = (int32_t)w;
+    if ((double)(a + 1) / (double)table_size > cum && w < vocab - 1) {
+      ++w;
+      cum += std::pow((double)counts[w], 0.75) / total;
+    }
+  }
+  return t;
+}
+
+extern "C" void ssn_neg_table_free(void* h) { delete (NegTable*)h; }
+
+// Train over n (center, context) pairs with `negatives` samples each.
+// Returns elapsed seconds (monotonic, excludes table setup).
+extern "C" double ssn_sgns_train(float* syn0, float* syn1, int dim,
+                                 const int32_t* centers, const int32_t* contexts,
+                                 int64_t n, int negatives, float lr,
+                                 void* neg_table_h, uint64_t seed) {
+  NegTable* nt = (NegTable*)neg_table_h;
+  const int64_t tsize = (int64_t)nt->table.size();
+  // precomputed sigmoid over [-kMaxExp, kMaxExp)
+  std::vector<float> exp_table((size_t)kExpTableSize);
+  for (int i = 0; i < kExpTableSize; ++i) {
+    float x = ((float)i / kExpTableSize * 2.0f - 1.0f) * kMaxExp;
+    float e = std::exp(x);
+    exp_table[(size_t)i] = e / (e + 1.0f);
+  }
+  std::vector<float> neu1e((size_t)dim);
+  uint64_t s = seed ^ 0xabcdef0123456789ULL;
+  auto t0 = std::chrono::steady_clock::now();
+  for (int64_t p = 0; p < n; ++p) {
+    float* v = syn0 + (int64_t)centers[p] * dim;
+    std::memset(neu1e.data(), 0, (size_t)dim * sizeof(float));
+    for (int d = 0; d <= negatives; ++d) {
+      int32_t target;
+      float label;
+      if (d == 0) {
+        target = contexts[p];
+        label = 1.0f;
+      } else {
+        target = nt->table[(size_t)(splitmix64(s) % (uint64_t)tsize)];
+        if (target == contexts[p]) continue;
+        label = 0.0f;
+      }
+      float* u = syn1 + (int64_t)target * dim;
+      float f = 0.0f;
+      for (int c = 0; c < dim; ++c) f += v[c] * u[c];
+      float g;
+      if (f > kMaxExp) g = (label - 1.0f) * lr;
+      else if (f < -kMaxExp) g = label * lr;
+      else
+        g = (label -
+             exp_table[(size_t)(int)((f + kMaxExp) *
+                                     (kExpTableSize / kMaxExp / 2.0f))]) *
+            lr;
+      for (int c = 0; c < dim; ++c) neu1e[c] += g * u[c];
+      for (int c = 0; c < dim; ++c) u[c] += g * v[c];
+    }
+    for (int c = 0; c < dim; ++c) v[c] += neu1e[c];
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  return std::chrono::duration<double>(t1 - t0).count();
 }
 
 // -------------------------------------------------------------- prefetch ---

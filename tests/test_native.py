@@ -106,3 +106,37 @@ def test_prefetcher_early_close_no_hang():
     it = iter(pf)
     next(it)
     pf.close()  # producer blocked on full queue must exit cleanly
+
+
+def test_sgns_train_learns_structure():
+    """The C baseline loop must actually train, not just loop fast.
+
+    Corpus: two disjoint word clusters; pairs only within a cluster. After
+    training, the average within-cluster in@out logit must exceed the
+    cross-cluster one (the SGNS objective separates the clusters).
+    """
+    rng = np.random.default_rng(0)
+    V, D, n = 200, 16, 60_000
+    half = V // 2
+    ca = rng.integers(0, half, size=n // 2)
+    cb = rng.integers(half, V, size=n // 2)
+    centers = np.concatenate([ca, cb]).astype(np.int32)
+    contexts = np.concatenate(
+        [rng.integers(0, half, size=n // 2), rng.integers(half, V, size=n // 2)]
+    ).astype(np.int32)
+    perm = rng.permutation(n)
+    centers, contexts = centers[perm], contexts[perm]
+    counts = np.bincount(np.concatenate([centers, contexts]), minlength=V).astype(
+        np.int64
+    )
+    syn0 = ((rng.random((V, D)) - 0.5) / D).astype(np.float32)
+    syn1 = np.zeros((V, D), dtype=np.float32)
+    dt = native.sgns_train(
+        syn0, syn1, centers, contexts, counts, negatives=5, lr=0.05, seed=1
+    )
+    assert dt > 0
+    assert np.isfinite(syn0).all() and np.isfinite(syn1).all()
+    logits = syn0 @ syn1.T  # [V, V] in@out
+    within = (logits[:half, :half].mean() + logits[half:, half:].mean()) / 2
+    cross = (logits[:half, half:].mean() + logits[half:, :half].mean()) / 2
+    assert within > cross + 0.5, (within, cross)
