@@ -111,7 +111,17 @@ class _Prefetcher:
             except BaseException as e:  # surfaced in __next__
                 self._err = e
             finally:
-                self._q.put(self._DONE)
+                # The sentinel must never strand this thread: with depth=1 a
+                # close() can drain, then our pending data put refills the
+                # queue, and a blocking put here would wait forever. Keep
+                # trying while live; once stopped, nobody will get() again.
+                while True:
+                    try:
+                        self._q.put(self._DONE, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            break
 
         self._thread = threading.Thread(target=produce, daemon=True)
         self._thread.start()
@@ -130,12 +140,13 @@ class _Prefetcher:
 
     def close(self):
         self._stop.set()
-        # drain so the producer's final put never blocks
+        # drain so the producer's pending put unblocks promptly, then reap it
         try:
             while True:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        self._thread.join(timeout=2.0)
 
 
 class TrainLoop:
@@ -170,7 +181,11 @@ class TrainLoop:
         if mesh is None or mesh.shape.get(DATA_AXIS, 1) == 1:
             return {k: jnp.asarray(v) for k, v in batch.items()}
         bs = batch_sharding(mesh)
-        return {k: jax.device_put(v, bs) for k, v in batch.items()}
+        rep = NamedSharding(mesh, P())  # scalars (e.g. lr-decay progress)
+        return {
+            k: jax.device_put(v, bs if np.ndim(v) else rep)
+            for k, v in batch.items()
+        }
 
     def run(self, seed: int = 0, max_steps: Optional[int] = None) -> Any:
         trainer = self.trainer

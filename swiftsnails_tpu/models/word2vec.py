@@ -103,6 +103,11 @@ class Word2VecTrainer(Trainer):
         self.window = cfg.get_int("window", 5)
         self.negatives = cfg.get_int("negatives", 5)
         self.lr = cfg.get_float("learning_rate", 0.025)
+        # word2vec.c convention: alpha decays linearly over the training run
+        # (words consumed / total words), floored at 1e-4 x the start rate.
+        # Off by default — the reference PS app surface (SwiftWorker.h:78-83)
+        # exposes a constant learning_rate; decay is the word2vec.c refinement.
+        self.lr_decay = cfg.get_bool("lr_decay", False)
         self.epochs = cfg.get_int("num_iters", 1)
         self.batch_size = cfg.get_int("batch_size", 1024)
         self.subsample = cfg.get_float("subsample", 1e-4)
@@ -135,6 +140,11 @@ class Word2VecTrainer(Trainer):
             and self.neg_mode == "pool"
             and mesh is None
         )
+        if self.fused and self.lr_decay:
+            # the fused kernel bakes lr in at Mosaic compile time
+            # (ops/fused_sgns.py static_argnames); a traced decayed lr
+            # cannot reach it
+            raise ValueError("lr_decay is not supported with fused: 1")
         # scan this many optimizer substeps per dispatch (amortizes host->TPU
         # dispatch latency). NOTE: TrainLoop steps/checkpoints count
         # dispatches, so substeps scale throughput, not the step counter.
@@ -188,13 +198,13 @@ class Word2VecTrainer(Trainer):
 
         return pull_collective_packed(self.mesh, table_state, rows)
 
-    def _ppush(self, table_state, rows, grads):
+    def _ppush(self, table_state, rows, grads, lr):
         if self.mesh is None:
-            return push_packed(table_state, rows, grads, self.access, self.lr)
+            return push_packed(table_state, rows, grads, self.access, lr)
         from swiftsnails_tpu.parallel.transfer import push_collective_packed
 
         return push_collective_packed(
-            self.mesh, table_state, rows, grads, self.access, self.lr
+            self.mesh, table_state, rows, grads, self.access, lr
         )
 
     # -- data --------------------------------------------------------------
@@ -220,14 +230,24 @@ class Word2VecTrainer(Trainer):
                     if self.subsample > 0:
                         chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
                     centers, contexts = skipgram_pairs(chunk, self.window, rng)
-                # macro-batches: steps_per_call optimizer steps per dispatch
-                yield from batch_stream(
-                    centers, contexts, self.batch_size * self.steps_per_call, rng
-                )
+                # macro-batches: steps_per_call optimizer steps per dispatch.
+                # progress = fraction of total corpus tokens consumed (raw
+                # tokens x epochs, the word2vec.c word_count convention) —
+                # drives linear lr decay when lr_decay is on.
+                total_tokens = max(self.epochs * len(ids), 1)
+                chunk_base = epoch * len(ids) + start
+                chunk_len = len(ids[start : start + self.chunk_tokens])
+                macro = self.batch_size * self.steps_per_call
+                n_batches = max(len(centers) // macro, 1)
+                for bi, b in enumerate(
+                    batch_stream(centers, contexts, macro, rng)
+                ):
+                    p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
+                    yield {**b, "progress": np.float32(min(p, 1.0))}
 
     # -- step --------------------------------------------------------------
 
-    def _substep_dense(self, state: W2VState, centers, contexts, rng):
+    def _substep_dense(self, state: W2VState, centers, contexts, rng, lr):
         """Reference-faithful substep: per-pair negatives, 2-D tables."""
         b = centers.shape[0]
         k = self.negatives
@@ -242,11 +262,11 @@ class Word2VecTrainer(Trainer):
             return sgns_loss(v, u[:b], u[b:].reshape(b, k, -1))
 
         loss, (dv, du) = jax.value_and_grad(loss_of, argnums=(0, 1))(v, u)
-        in_table = push(state.in_table, in_rows, dv, self.access, self.lr)
-        out_table = push(state.out_table, out_rows, du, self.access, self.lr)
+        in_table = push(state.in_table, in_rows, dv, self.access, lr)
+        out_table = push(state.out_table, out_rows, du, self.access, lr)
         return W2VState(in_table, out_table), loss
 
-    def _substep_packed(self, state: W2VState, centers, contexts, rng):
+    def _substep_packed(self, state: W2VState, centers, contexts, rng, lr):
         """Fast substep: packed tables, row-DMA pull/push, pooled negatives.
 
         Each block of ``pool_block`` consecutive pairs shares ``pool_size``
@@ -292,11 +312,11 @@ class Word2VecTrainer(Trainer):
             v, u_pos, pool
         )
         du = jnp.concatenate([du_pos, dpool.reshape(-1, *dpool.shape[2:])])
-        in_table = self._ppush(state.in_table, in_rows, dv)
-        out_table = self._ppush(state.out_table, out_rows, du)
+        in_table = self._ppush(state.in_table, in_rows, dv, lr)
+        out_table = self._ppush(state.out_table, out_rows, du, lr)
         return W2VState(in_table, out_table), loss
 
-    def _substep_fused(self, state: W2VState, centers, contexts, rng):
+    def _substep_fused(self, state: W2VState, centers, contexts, rng, lr):
         """Single-kernel hogwild substep (see ops/fused_sgns.py)."""
         from swiftsnails_tpu.ops import rowdma
         from swiftsnails_tpu.ops.fused_sgns import fused_sgns_step
@@ -325,7 +345,7 @@ class Word2VecTrainer(Trainer):
             PackedTableState(table=out_t, slots=state.out_table.slots),
         ), loss
 
-    def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng):
+    def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
         """Packed tables with reference-faithful per-pair K negatives."""
         b = centers.shape[0]
         k = self.negatives
@@ -349,8 +369,8 @@ class Word2VecTrainer(Trainer):
             v, u_pos, u_neg
         )
         du = jnp.concatenate([du_pos, du_neg.reshape(-1, *du_neg.shape[2:])])
-        in_table = self._ppush(state.in_table, in_rows, dv)
-        out_table = self._ppush(state.out_table, out_rows, du)
+        in_table = self._ppush(state.in_table, in_rows, dv, lr)
+        out_table = self._ppush(state.out_table, out_rows, du, lr)
         return W2VState(in_table, out_table), loss
 
     def train_step(self, state: W2VState, batch, rng):
@@ -370,13 +390,21 @@ class Word2VecTrainer(Trainer):
         else:
             substep = self._substep_dense
 
+        # word2vec.c linear decay: lr * max(1 - progress, 1e-4). progress is
+        # a replicated scalar supplied by batches(); constant within one
+        # dispatch (the per-substep refinement is below batch granularity).
+        if self.lr_decay and "progress" in batch:
+            lr = self.lr * jnp.maximum(1.0 - batch["progress"], 1e-4)
+        else:
+            lr = self.lr
+
         if t == 1:
-            state, loss = substep(state, centers, contexts, rng)
+            state, loss = substep(state, centers, contexts, rng, lr)
             return state, {"loss": loss}
 
         def body(st, xs):
             c, x, key = xs
-            st, loss = substep(st, c, x, key)
+            st, loss = substep(st, c, x, key, lr)
             return st, loss
 
         keys = jax.random.split(rng, t)

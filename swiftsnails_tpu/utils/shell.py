@@ -69,15 +69,55 @@ class ManagedPipe:
             raise RuntimeError(f"pipe command failed ({rc}): {self.cmd}")
 
 
+class _PipeReader:
+    """File-like over a child's stdout that reaps the child on close and
+    raises on nonzero exit (matching ManagedPipe's failure semantics) —
+    returning the bare stdout would leak a zombie and swallow pipefail."""
+
+    def __init__(self, cmd: str):
+        self.cmd = cmd
+        self._closed = False
+        self._proc = subprocess.Popen(
+            ["bash", "-o", "pipefail", "-c", cmd],
+            stdout=subprocess.PIPE,
+            text=True,
+        )
+        assert self._proc.stdout is not None
+
+    def __getattr__(self, name):
+        return getattr(self._proc.stdout, name)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._proc.stdout)
+
+    def __enter__(self) -> "_PipeReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # like ManagedPipe: don't let a pipe-exit error (often EPIPE from our
+        # own early close) mask an in-flight exception from the with-body
+        if exc == (None, None, None):
+            self.close()
+        else:
+            self._reap()
+
+    def _reap(self) -> int:
+        if self._proc.stdout and not self._proc.stdout.closed:
+            self._proc.stdout.close()
+        return self._proc.wait()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        rc = self._reap()
+        if rc != 0:
+            raise RuntimeError(f"pipe command failed ({rc}): {self.cmd}")
+
+
 def open_maybe_pipe(path: str) -> IO[str]:
     """Open a data path; a trailing ``|`` means "command pipe" (HDFS-pipe
     pattern from the reference's deploy scripts)."""
     if path.endswith("|"):
-        proc = subprocess.Popen(
-            ["bash", "-o", "pipefail", "-c", path[:-1].strip()],
-            stdout=subprocess.PIPE,
-            text=True,
-        )
-        assert proc.stdout is not None
-        return proc.stdout
+        return _PipeReader(path[:-1].strip())
     return open(path, "r", encoding="utf-8", errors="replace")

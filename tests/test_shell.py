@@ -1,0 +1,55 @@
+"""Shell/pipe helpers (GlobalShell parity: pipefail, managed child reaping)."""
+
+import pytest
+
+from swiftsnails_tpu.utils.shell import (
+    ManagedPipe,
+    execute,
+    get_command_output,
+    open_maybe_pipe,
+)
+
+
+def test_execute_pipefail():
+    assert execute("true | true") == 0
+    with pytest.raises(RuntimeError):
+        execute("false | true")  # pipefail propagates the left failure
+
+
+def test_get_command_output():
+    assert get_command_output("printf hello").strip() == "hello"
+
+
+def test_managed_pipe_reads_and_raises():
+    with ManagedPipe("printf 'a\\nb\\n'") as f:
+        assert [l.strip() for l in f] == ["a", "b"]
+    with pytest.raises(RuntimeError):
+        with ManagedPipe("false"):
+            pass
+
+
+def test_open_maybe_pipe_plain_file(tmp_path):
+    p = tmp_path / "x.txt"
+    p.write_text("x\n")
+    with open_maybe_pipe(str(p)) as f:
+        assert f.read() == "x\n"
+
+
+def test_open_maybe_pipe_command():
+    with open_maybe_pipe("printf 'a\\nb\\n' |") as f:
+        assert [l.strip() for l in f] == ["a", "b"]
+
+
+def test_open_maybe_pipe_raises_on_failure_and_close_idempotent():
+    f = open_maybe_pipe("false |")
+    f.read()
+    with pytest.raises(RuntimeError):
+        f.close()
+    f.close()  # second close is a no-op, not a re-raise
+
+
+def test_open_maybe_pipe_body_exception_not_masked():
+    with pytest.raises(ValueError, match="body"):
+        with open_maybe_pipe("yes |") as f:
+            f.readline()
+            raise ValueError("body error")
