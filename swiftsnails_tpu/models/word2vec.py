@@ -157,6 +157,13 @@ class Word2VecTrainer(Trainer):
                 min_count=cfg.get_int("min_count", 5),
                 max_vocab=cfg.get_int("max_vocab", 0) or None,
             )
+            # Multi-host: train on this process's contiguous corpus span
+            # (stdin-split parity; vocab stays global so ids/placement agree
+            # across hosts). shard_data: 0 restores every-host-trains-all.
+            if cfg.get_bool("shard_data", True):
+                from swiftsnails_tpu.parallel.cluster import shard_token_stream
+
+                corpus_ids = shard_token_stream(corpus_ids)
         assert vocab is not None, "vocab required when corpus_ids is given"
         self.corpus_ids = np.asarray(corpus_ids, dtype=np.int32)
         self.vocab = vocab

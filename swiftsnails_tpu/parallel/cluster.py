@@ -29,6 +29,8 @@ from __future__ import annotations
 import logging
 from typing import List, Optional, Sequence, Tuple
 
+import numpy as np
+
 import jax
 
 from swiftsnails_tpu.utils.config import Config
@@ -84,7 +86,44 @@ def local_data_shard(paths: Sequence[str]) -> List[str]:
 
     Files are assigned round-robin by process index; with fewer files than
     processes, callers should fall back to record-level sharding
-    (:func:`swiftsnails_tpu.data.text.iter_line_records`).
+    (:func:`shard_rows` / :func:`swiftsnails_tpu.data.text.iter_line_records`).
     """
     idx, count = process_info()
     return [p for i, p in enumerate(paths) if i % count == idx]
+
+
+def shard_token_stream(
+    ids: np.ndarray,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> np.ndarray:
+    """This process's contiguous span of an encoded token stream.
+
+    The reference gave each worker a contiguous region of the corpus (its
+    Hadoop stdin split, ``run_worker.sh``); contiguity matters for window
+    models — a strided split would cut every skip-gram context. Spans come
+    from ``np.array_split`` so they are disjoint and cover the corpus.
+    """
+    if process_count is None:
+        process_index, process_count = process_info()
+    if process_count <= 1:
+        return ids
+    return np.array_split(ids, process_count)[process_index]
+
+
+def shard_rows(
+    *arrays: np.ndarray,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[np.ndarray, ...]:
+    """This process's round-robin row subset of record-oriented arrays.
+
+    Line-record equivalent of the stdin split (same assignment as
+    ``iter_line_records``: record ``i`` belongs to process ``i % count``),
+    applied in parallel to aligned arrays (labels, features, ...).
+    """
+    if process_count is None:
+        process_index, process_count = process_info()
+    if process_count <= 1:
+        return arrays if len(arrays) != 1 else (arrays[0],)
+    return tuple(a[process_index::process_count] for a in arrays)
