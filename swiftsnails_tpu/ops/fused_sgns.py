@@ -102,18 +102,20 @@ def _kernel(in_rows_ref, pos_rows_ref, pool_rows_ref,
     uv = u_buf[slot].astype(jnp.float32).reshape(P, -1)
     pv = p_buf[slot].astype(jnp.float32).reshape(PN, -1)
 
-    pos = jnp.sum(vv * uv, axis=1)  # [P]
+    # keepdims throughout: rank-1 [P] intermediates hit a Mosaic relayout
+    # limitation (implicit-dim vector<1x512xf32> -> replicated-lane form)
+    pos = jnp.sum(vv * uv, axis=1, keepdims=True)  # [P, 1]
     neg = jax.lax.dot_general(
         vv, pv, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [P, PN]
 
-    g_pos = (jax.nn.sigmoid(pos) - 1.0) * inv_b  # [P]
+    g_pos = (jax.nn.sigmoid(pos) - 1.0) * inv_b  # [P, 1]
     g_neg = (lam * inv_b) * jax.nn.sigmoid(neg)  # [P, PN]
 
-    dv = g_pos[:, None] * uv + jax.lax.dot_general(
+    dv = g_pos * uv + jax.lax.dot_general(
         g_neg, pv, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
-    du = g_pos[:, None] * vv
+    du = g_pos * vv
     dp = jax.lax.dot_general(
         g_neg, vv, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )  # [PN, D]
