@@ -149,6 +149,15 @@ class Word2VecTrainer(Trainer):
         # dispatch latency). NOTE: TrainLoop steps/checkpoints count
         # dispatches, so substeps scale throughput, not the step counter.
         self.steps_per_call = max(cfg.get_int("steps_per_call", 1), 1)
+        # push_mode: "gather" = exact all_gather-over-data push (default);
+        # "bucketed" = owner-bucketed push (transfer.push_collective_packed_
+        # bucketed): ~model/slack less ICI traffic, MoE-style static bucket
+        # capacity — distinct owned rows beyond cap are dropped for the step
+        # and reported in the `push_dropped` metric.
+        self.push_mode = cfg.get_str("push_mode", "gather")
+        if self.push_mode not in ("gather", "bucketed"):
+            raise ValueError(f"push_mode must be gather|bucketed, got {self.push_mode}")
+        self.bucket_slack = cfg.get_float("bucket_slack", 2.0)
 
         if corpus_ids is None:
             data_path = cfg.get_str("data")
@@ -206,13 +215,24 @@ class Word2VecTrainer(Trainer):
         return pull_collective_packed(self.mesh, table_state, rows)
 
     def _ppush(self, table_state, rows, grads, lr):
+        """Returns ``(new_table_state, dropped)`` — dropped is always 0 except
+        in bucketed push mode (static bucket overflow, see transfer.py)."""
         if self.mesh is None:
-            return push_packed(table_state, rows, grads, self.access, lr)
+            return push_packed(table_state, rows, grads, self.access, lr), jnp.int32(0)
+        if self.push_mode == "bucketed":
+            from swiftsnails_tpu.parallel.transfer import (
+                push_collective_packed_bucketed,
+            )
+
+            return push_collective_packed_bucketed(
+                self.mesh, table_state, rows, grads, self.access, lr,
+                slack=self.bucket_slack,
+            )
         from swiftsnails_tpu.parallel.transfer import push_collective_packed
 
         return push_collective_packed(
             self.mesh, table_state, rows, grads, self.access, lr
-        )
+        ), jnp.int32(0)
 
     # -- data --------------------------------------------------------------
 
@@ -271,7 +291,7 @@ class Word2VecTrainer(Trainer):
         loss, (dv, du) = jax.value_and_grad(loss_of, argnums=(0, 1))(v, u)
         in_table = push(state.in_table, in_rows, dv, self.access, lr)
         out_table = push(state.out_table, out_rows, du, self.access, lr)
-        return W2VState(in_table, out_table), loss
+        return W2VState(in_table, out_table), loss, jnp.int32(0)
 
     def _substep_packed(self, state: W2VState, centers, contexts, rng, lr):
         """Fast substep: packed tables, row-DMA pull/push, pooled negatives.
@@ -319,9 +339,9 @@ class Word2VecTrainer(Trainer):
             v, u_pos, pool
         )
         du = jnp.concatenate([du_pos, dpool.reshape(-1, *dpool.shape[2:])])
-        in_table = self._ppush(state.in_table, in_rows, dv, lr)
-        out_table = self._ppush(state.out_table, out_rows, du, lr)
-        return W2VState(in_table, out_table), loss
+        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr)
+        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr)
+        return W2VState(in_table, out_table), loss, d1 + d2
 
     def _substep_fused(self, state: W2VState, centers, contexts, rng, lr):
         """Single-kernel hogwild substep (see ops/fused_sgns.py)."""
@@ -350,7 +370,7 @@ class Word2VecTrainer(Trainer):
         return W2VState(
             PackedTableState(table=in_t, slots=state.in_table.slots),
             PackedTableState(table=out_t, slots=state.out_table.slots),
-        ), loss
+        ), loss, jnp.int32(0)
 
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
         """Packed tables with reference-faithful per-pair K negatives."""
@@ -376,9 +396,9 @@ class Word2VecTrainer(Trainer):
             v, u_pos, u_neg
         )
         du = jnp.concatenate([du_pos, du_neg.reshape(-1, *du_neg.shape[2:])])
-        in_table = self._ppush(state.in_table, in_rows, dv, lr)
-        out_table = self._ppush(state.out_table, out_rows, du, lr)
-        return W2VState(in_table, out_table), loss
+        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr)
+        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr)
+        return W2VState(in_table, out_table), loss, d1 + d2
 
     def train_step(self, state: W2VState, batch, rng):
         """One dispatch = ``steps_per_call`` optimizer substeps under lax.scan."""
@@ -405,20 +425,26 @@ class Word2VecTrainer(Trainer):
         else:
             lr = self.lr
 
+        def metrics_of(loss, dropped):
+            m = {"loss": loss}
+            if self.push_mode == "bucketed":
+                m["push_dropped"] = dropped
+            return m
+
         if t == 1:
-            state, loss = substep(state, centers, contexts, rng, lr)
-            return state, {"loss": loss}
+            state, loss, dropped = substep(state, centers, contexts, rng, lr)
+            return state, metrics_of(loss, dropped)
 
         def body(st, xs):
             c, x, key = xs
-            st, loss = substep(st, c, x, key, lr)
-            return st, loss
+            st, loss, dropped = substep(st, c, x, key, lr)
+            return st, (loss, dropped)
 
         keys = jax.random.split(rng, t)
-        state, losses = jax.lax.scan(
+        state, (losses, drops) = jax.lax.scan(
             body, state, (centers.reshape(t, b), contexts.reshape(t, b), keys)
         )
-        return state, {"loss": losses.mean()}
+        return state, metrics_of(losses.mean(), drops.sum())
 
     # -- export (ServerTerminate parity: text dump of the table) -----------
 

@@ -46,6 +46,41 @@ def _rows_per_shard(capacity: int, mesh: Mesh) -> int:
     return capacity // model
 
 
+def bucket_capacity(local_n: int, model: int, slack: float) -> int:
+    """Static per-sender bucket size for the owner-bucketed push.
+
+    Mean occupancy after dedup is ``<= local_n / model`` under hashed (uniform)
+    row placement; ``slack`` (default 2) puts the cap at slack x mean, rounded
+    up to a multiple of 8 (sublane-friendly), clamped to ``local_n`` (at which
+    point the bucketed path degenerates to the exact all_gather).
+    """
+    if model <= 1:
+        return local_n
+    cap = -(-int(slack * local_n) // model)
+    cap = -(-cap // 8) * 8
+    return min(cap, local_n)
+
+
+def _compact_owned(uniq, merged, m, per, cap, invalid):
+    """Select the rows of a deduped batch owned by model shard ``m``,
+    compacted (stable, owned-first) into a static ``[cap]`` bucket.
+
+    Returns ``(bucket_rows, bucket_grads, overflow)`` where ``overflow`` is
+    the number of distinct owned rows that did not fit (their gradients are
+    dropped by the caller — see :func:`push_collective_bucketed`).
+    """
+    local = uniq - m * per
+    owned = (local >= 0) & (local < per)
+    order = jnp.argsort(~owned, stable=True)  # owned first, original order
+    take = order[:cap]
+    ok = owned[take]
+    b_rows = jnp.where(ok, uniq[take], invalid)
+    mask = ok.reshape(ok.shape + (1,) * (merged.ndim - 1))
+    b_grads = jnp.where(mask, merged[take], 0)
+    overflow = jnp.maximum(owned.sum() - cap, 0)
+    return b_rows, b_grads, overflow
+
+
 def pull_collective(mesh: Mesh, state: TableState, rows: jax.Array) -> jax.Array:
     """Sharded gather with explicit psum-over-model (pull protocol)."""
     per = _rows_per_shard(state.capacity, mesh)
@@ -184,3 +219,121 @@ def push_collective_packed(
     )
     table, slots = fn(state.table, dict(state.slots), rows, grads)
     return PackedTableState(table=table, slots=slots)
+
+
+# --------------------------------------------------- owner-bucketed push ---
+#
+# The all_gather push above moves every data shard's FULL (rows, grads) batch
+# to every model shard, then masks to the ~1/model owned fraction — O(B*dim*
+# data) received per device, the naive version of the survey's bucketed
+# design (SURVEY §2.3 Transfer row: all_to_all of (key,grad) buckets by
+# owner; reference shape: per-server request batching in
+# src/core/parameter/global_push_access.h:58-99).
+#
+# Bucketed variant: the batch is replicated over `model` inside each data
+# shard, so every sender can locally (a) merge duplicates, then (b) compact
+# the rows owned by ITS OWN model index into a static [cap] bucket. The
+# all_gather over `data` then carries cap rows instead of the full local
+# batch — a ~model/slack traffic reduction, the exact sparse analog of
+# reduce_scatter-by-owner. No model-axis collective is needed at all: the
+# "send to owner" hop of the reference protocol is free here because the
+# batch is already replicated over `model`.
+#
+# Static-shape overflow contract (same tradeoff as MoE expert-capacity
+# dispatch): a bucket can hold at most `cap` DISTINCT owned rows; rows
+# beyond that are dropped for the step and counted in the returned
+# `dropped` scalar (replicated). With murmur-hashed placement the owned
+# count concentrates at local_n/model (binomial), so slack=2 makes overflow
+# probability astronomically small; cap == local_n (slack >= model) is
+# byte-exact always. Callers surface `dropped` as a metric so a silent
+# quality regression is impossible.
+
+
+def push_collective_bucketed(
+    mesh: Mesh,
+    state: TableState,
+    rows: jax.Array,
+    grads: jax.Array,
+    access: AccessMethod,
+    lr,
+    slack: float = 2.0,
+):
+    """Owner-bucketed sharded push. Returns ``(new_state, dropped)``."""
+    per = _rows_per_shard(state.capacity, mesh)
+    model = mesh.shape[MODEL_AXIS]
+    local_n = rows.shape[0] // mesh.shape[DATA_AXIS]
+    cap = bucket_capacity(local_n, model, slack)
+    slot_keys = sorted(state.slots.keys())
+    invalid = state.capacity
+
+    def local_push(table_shard, slot_shards, rows_local, grads_local):
+        m = lax.axis_index(MODEL_AXIS)
+        uniq_l, merged_l = merge_duplicate_rows(rows_local, grads_local, invalid_row=invalid)
+        b_rows, b_grads, overflow = _compact_owned(uniq_l, merged_l, m, per, cap, invalid)
+        rows_all = lax.all_gather(b_rows, DATA_AXIS, tiled=True)
+        grads_all = lax.all_gather(b_grads, DATA_AXIS, tiled=True)
+        local_ids = rows_all - m * per  # all owned-by-m or invalid padding
+        owned = (local_ids >= 0) & (local_ids < per)
+        local_ids = jnp.where(owned, local_ids, per)
+        uniq, merged = merge_duplicate_rows(local_ids, grads_all, invalid_row=per)
+        table, slots = apply_rows(table_shard, slot_shards, uniq, merged, access, lr)
+        dropped = lax.psum(lax.psum(overflow, DATA_AXIS), MODEL_AXIS)
+        return table, slots, dropped
+
+    shard_spec = P(MODEL_AXIS, None)
+    fn = shard_map(
+        local_push,
+        mesh=mesh,
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
+        check_vma=False,
+    )
+    table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
+    return TableState(table=table, slots=slots), dropped
+
+
+def push_collective_packed_bucketed(
+    mesh: Mesh,
+    state,
+    rows: jax.Array,
+    grads: jax.Array,
+    access: AccessMethod,
+    lr,
+    slack: float = 2.0,
+):
+    """Owner-bucketed packed push ([N, S, 128] grads). Returns ``(state, dropped)``."""
+    from swiftsnails_tpu.parallel.store import PackedTableState, push_packed
+
+    per = _rows_per_shard(state.capacity, mesh)
+    model = mesh.shape[MODEL_AXIS]
+    local_n = rows.shape[0] // mesh.shape[DATA_AXIS]
+    cap = bucket_capacity(local_n, model, slack)
+    slot_keys = sorted(state.slots.keys())
+    invalid = state.capacity
+
+    def local_push(table_shard, slot_shards, rows_local, grads_local):
+        m = lax.axis_index(MODEL_AXIS)
+        uniq_l, merged_l = merge_duplicate_rows(rows_local, grads_local, invalid_row=invalid)
+        b_rows, b_grads, overflow = _compact_owned(uniq_l, merged_l, m, per, cap, invalid)
+        rows_all = lax.all_gather(b_rows, DATA_AXIS, tiled=True)
+        grads_all = lax.all_gather(b_grads, DATA_AXIS, tiled=True)
+        local_ids = rows_all - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        local_ids = jnp.where(owned, local_ids, per)
+        grads_all = jnp.where(owned[:, None, None], grads_all, 0)
+        shard_state = PackedTableState(table=table_shard, slots=slot_shards)
+        new = push_packed(shard_state, local_ids, grads_all, access, lr)
+        dropped = lax.psum(lax.psum(overflow, DATA_AXIS), MODEL_AXIS)
+        return new.table, dict(new.slots), dropped
+
+    shard_spec = P(MODEL_AXIS, None, None)
+    fn = shard_map(
+        local_push,
+        mesh=mesh,
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
+                  P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
+        check_vma=False,
+    )
+    table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
+    return PackedTableState(table=table, slots=slots), dropped
