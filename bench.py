@@ -73,12 +73,17 @@ _state = {
     "best": 0.0,
     "best_path": None,
     "paths": {},  # name -> words/sec
+    "quality": {},  # name -> held-out per-pair SGNS eval loss (lower=better)
+    "quality_pair_top1": {},  # name -> structured-corpus probe score in [0,1]
     "baseline_node": None,  # per-node words/sec
     "baseline_kind": None,  # "c-loop" | "numpy"
     "pairs_per_token": None,
     "platform": None,
     "errors": [],
 }
+# a path may claim the headline number only if its eval loss is within this
+# factor of the reference-faithful dense path's (fast-but-wrong cannot ship)
+QUALITY_TOLERANCE = 1.15
 _emit_lock = threading.Lock()
 _emitted = False
 
@@ -115,6 +120,10 @@ def _result_json(extra_error=None):
             "baseline_kind": _state["baseline_kind"],
             "path": _state["best_path"],
             "paths": {k: round(v, 1) for k, v in _state["paths"].items()},
+            "quality": {k: round(v, 4) for k, v in _state["quality"].items()},
+            "quality_pair_top1": {
+                k: round(v, 3) for k, v in _state["quality_pair_top1"].items()
+            },
             "pairs_per_token": (
                 round(_state["pairs_per_token"], 3)
                 if _state["pairs_per_token"]
@@ -262,6 +271,7 @@ def _measure_tpu_config(counts, batches, pairs_per_token, overrides):
 
     t_short = timed_run(CALIB_STEPS, 100)
     t_long = timed_run(MEASURE_STEPS, 200)
+    quality = _eval_quality(trainer, state)
     dt_diff = (t_long - t_short) / (MEASURE_STEPS - CALIB_STEPS)
     # Upper bound that still contains the constant per-run overhead: the
     # differenced estimate must land in (0.2x, 1x] of it; outside that band
@@ -271,11 +281,68 @@ def _measure_tpu_config(counts, batches, pairs_per_token, overrides):
     dt_ub = t_long / MEASURE_STEPS
     dt = dt_diff if (0.2 * dt_ub) < dt_diff <= dt_ub else dt_ub
     pairs_per_sec = STEPS_PER_CALL * BATCH / dt
-    return pairs_per_sec / pairs_per_token
+    return pairs_per_sec / pairs_per_token, quality
+
+
+_EVAL = {}  # fixed held-out (centers, contexts, negs), built once
+
+
+# Structured-corpus quality probe (shared with the CI gate so the bar and
+# corpus cannot drift: swiftsnails_tpu/framework/quality.py). The held-out
+# eval loss above cannot discriminate at bench scale — out tables start at
+# zero, word2vec.c-style, so logits stay ~0 within the measurement window —
+# while the probe's 128-word paired corpus learns structure in seconds. On
+# TPU the fused path runs the REAL racy kernel (hardware hogwild), not the
+# serialized interpret-mode approximation CI sees.
+
+
+def _eval_quality(trainer, state) -> float:
+    """Held-out per-pair SGNS eval loss of a trained state.
+
+    One metric for every path (per-pair loss, fixed pairs, fixed uniform
+    negatives), so pooled/hogwild semantic changes are measured on the
+    reference-faithful objective. Every path trains from the same init for
+    the same number of substeps, so the values are comparable; ~ln2*(1+K)
+    = 4.16 means untrained/diverged.
+    """
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.models.word2vec import sgns_loss
+    from swiftsnails_tpu.ops.rowdma import unpack_rows
+    from swiftsnails_tpu.parallel.store import pull
+
+    c = jnp.asarray(_EVAL["centers"])
+    x = jnp.asarray(_EVAL["contexts"])
+    negs = jnp.asarray(_EVAL["negs"])
+    b, k = negs.shape
+    in_rows = trainer._rows(c)
+    out_rows = trainer._rows(jnp.concatenate([x, negs.reshape(-1)]))
+    if trainer.packed:
+        v = unpack_rows(
+            state.in_table.table.at[in_rows].get(mode="promise_in_bounds"), trainer.dim
+        )
+        u = unpack_rows(
+            state.out_table.table.at[out_rows].get(mode="promise_in_bounds"), trainer.dim
+        )
+    else:
+        v = pull(state.in_table, in_rows)
+        u = pull(state.out_table, out_rows)
+    return float(sgns_loss(v.astype(jnp.float32), u[:b].astype(jnp.float32),
+                           u[b:].reshape(b, k, -1).astype(jnp.float32)))
 
 
 def measure_tpu_paths(counts, batches, pairs_per_token):
-    """Safest path first; each completed path updates best-so-far."""
+    """Safest path first; each completed path updates best-so-far.
+
+    Headline eligibility (fast-but-wrong cannot ship, VERDICT r1 weak #3):
+    the dense path is reference-faithful by definition and qualifies with a
+    finite eval loss; a FAST path must additionally score >= MIN_TOP1 on the
+    structured-corpus probe (shared with CI). A probe that errors or is
+    skipped for budget leaves the fast path's quality UNPROVEN: throughput
+    is recorded, eligibility is withheld — an infra failure therefore never
+    zeroes the headline (dense already holds it), and an unverified fast
+    path never claims it.
+    """
     pool = {
         "packed": "1",
         "neg_mode": "pool",
@@ -287,6 +354,7 @@ def measure_tpu_paths(counts, batches, pairs_per_token):
         ("packed+pool", pool),
         ("fused-hogwild", {**pool, "fused": "1"}),
     ]
+    ref_quality = None
     for name, overrides in paths:
         remaining = BENCH_DEADLINE_S - (time.monotonic() - _T0)
         if remaining < PATH_MIN_BUDGET_S:
@@ -295,17 +363,51 @@ def measure_tpu_paths(counts, batches, pairs_per_token):
             )
             break
         try:
-            wps = _measure_tpu_config(counts, batches, pairs_per_token, overrides)
+            wps, qual = _measure_tpu_config(
+                counts, batches, pairs_per_token, overrides
+            )
         except Exception as e:  # Mosaic/compile failure -> next path
             msg = f"{name} path failed ({type(e).__name__}: {e})"
             print(f"bench: {msg}", file=sys.stderr)
             _state["errors"].append(msg)
             continue
+        from swiftsnails_tpu.framework.quality import MIN_TOP1, probe_top1
+
         _state["paths"][name] = wps
-        if wps > _state["best"]:
+        _state["quality"][name] = qual
+        top1 = float("nan")
+        if name != "dense":  # dense is reference-faithful; no probe needed
+            if BENCH_DEADLINE_S - (time.monotonic() - _T0) < 60:
+                _state["errors"].append(
+                    f"{name}: quality probe skipped (budget); not headline-eligible"
+                )
+            else:
+                try:
+                    top1 = probe_top1(dict(overrides))
+                except Exception as e:
+                    _state["errors"].append(f"{name} quality probe failed: {e}")
+            _state["quality_pair_top1"][name] = top1
+        if name == "dense":
+            ref_quality = qual
+            eligible = qual == qual  # finite eval loss
+        else:
+            eligible = qual == qual and top1 == top1 and top1 >= MIN_TOP1
+            if eligible and ref_quality is not None and ref_quality == ref_quality:
+                eligible = qual <= ref_quality * QUALITY_TOLERANCE
+            if not eligible:
+                _state["errors"].append(
+                    f"{name}: quality unproven or failed (eval loss {qual:.4f}"
+                    f", pair top-1 {top1:.3f}, bar {MIN_TOP1}); throughput "
+                    "recorded but not eligible for the headline"
+                )
+        if eligible and wps > _state["best"]:
             _state["best"] = wps
             _state["best_path"] = name
-        print(f"bench: {name}: {wps:,.0f} words/sec", file=sys.stderr)
+        print(
+            f"bench: {name}: {wps:,.0f} words/sec, eval loss {qual:.4f}, "
+            f"pair top-1 {top1:.3f}",
+            file=sys.stderr,
+        )
 
 
 def measure_cpu_baseline(batches, pairs_per_token: float, counts) -> None:
@@ -378,6 +480,25 @@ def main():
     centers, contexts = skipgram_pairs(ids, WINDOW, rng)
     pairs_per_token = len(centers) / n_tokens
     _state["pairs_per_token"] = pairs_per_token
+    # held-out eval pairs for the per-path quality gate — training batches
+    # come from the rest. Restricted to frequent-word pairs with unigram
+    # negatives: rows touched often enough in a ~1-minute run that a wrong
+    # update rule visibly moves the eval loss (rare-row logits stay ~0 and
+    # would pin every path at the untrained ln2*(1+K)).
+    tail = slice(len(centers) - 200_000, len(centers))
+    hot = np.argsort(counts)[-2000:]
+    hot_mask = np.isin(centers[tail], hot) & np.isin(contexts[tail], hot)
+    n_eval = 4096
+    ev_idx = np.flatnonzero(hot_mask)[:n_eval]
+    if len(ev_idx) < 256:  # degenerate counts: fall back to unrestricted
+        ev_idx = np.arange(min(n_eval, tail.stop - tail.start))
+    _EVAL["centers"] = centers[tail][ev_idx]
+    _EVAL["contexts"] = contexts[tail][ev_idx]
+    neg_pool = np.repeat(np.arange(VOCAB), np.minimum(counts, 1000))
+    _EVAL["negs"] = rng.choice(
+        neg_pool, size=(len(ev_idx), NEGATIVES)
+    ).astype(np.int32)
+    centers, contexts = centers[: tail.start], contexts[: tail.start]
     macro = BATCH * STEPS_PER_CALL
     batches = list(batch_stream(centers, contexts, macro, rng))[:8]
     batches = [b for b in batches if b["centers"].shape[0] == macro]

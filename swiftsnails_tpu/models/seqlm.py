@@ -67,6 +67,12 @@ class SeqLMTrainer(Trainer):
                 max_vocab=cfg.get_int("max_vocab", 0) or None,
             )
             vocab_size = len(vocab)
+            # multi-host contiguous corpus span (stdin-split parity); the
+            # global vocab keeps token ids consistent across hosts
+            if cfg.get_bool("shard_data", True):
+                from swiftsnails_tpu.parallel.cluster import shard_token_stream
+
+                corpus_ids = shard_token_stream(corpus_ids)
         self.corpus_ids = np.asarray(corpus_ids, dtype=np.int32)
         self.vocab_size = int(vocab_size)
         if self.d_model % self.n_heads:

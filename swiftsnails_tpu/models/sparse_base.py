@@ -92,6 +92,13 @@ class SparseCTRTrainer(Trainer):
                 self.labels, self.feats = read_ctr_file(
                     cfg.get_str("data"), self.num_fields
                 )
+            # Multi-host: each process trains its round-robin record subset
+            # (stdin-split parity, run_worker.sh; record i -> process
+            # i % count like iter_line_records). shard_data: 0 disables.
+            if cfg.get_bool("shard_data", True):
+                from swiftsnails_tpu.parallel.cluster import shard_rows
+
+                self.labels, self.feats = shard_rows(self.labels, self.feats)
 
     # -- subclass API ------------------------------------------------------
 

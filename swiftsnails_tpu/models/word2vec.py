@@ -157,6 +157,12 @@ class Word2VecTrainer(Trainer):
         self.push_mode = cfg.get_str("push_mode", "gather")
         if self.push_mode not in ("gather", "bucketed"):
             raise ValueError(f"push_mode must be gather|bucketed, got {self.push_mode}")
+        if self.push_mode == "bucketed" and (not self.packed or self.fused):
+            # only the packed collective path routes through _ppush; dense
+            # uses the pjit store.push and fused bypasses push entirely —
+            # accepting the key there would silently run the exact push
+            # while reporting push_dropped: 0
+            raise ValueError("push_mode: bucketed requires packed: 1 without fused: 1")
         self.bucket_slack = cfg.get_float("bucket_slack", 2.0)
 
         if corpus_ids is None:

@@ -30,13 +30,20 @@ Slots = Dict[str, jax.Array]
 class AccessMethod:
     """Base update rule. Subclass and override; all methods are jit-safe."""
 
-    def init_param(self, rng: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+    def init_param(
+        self, rng: jax.Array, shape: Tuple[int, ...], dtype,
+        fan_in: Optional[int] = None,
+    ) -> jax.Array:
         """Initial parameter values.
 
         Default matches the reference's ``Vec::randInit``: U(-0.5, 0.5)/dim
         (``src/utils/vec1.h:223-226``) — the classic word2vec embedding init.
+        ``fan_in`` overrides the scaling dim when the storage row is wider
+        than the logical row (packed ``[C, S, 128]`` layouts pad the last
+        axis; scaling by the padded width would shrink the init by up to
+        128/dim and visibly slow early training).
         """
-        dim = shape[-1] if len(shape) > 1 else 1
+        dim = fan_in or (shape[-1] if len(shape) > 1 else 1)
         return jax.random.uniform(rng, shape, dtype=dtype, minval=-0.5, maxval=0.5) / dim
 
     def init_slots(self, shape: Tuple[int, ...], dtype) -> Slots:

@@ -125,5 +125,5 @@ def shard_rows(
     if process_count is None:
         process_index, process_count = process_info()
     if process_count <= 1:
-        return arrays if len(arrays) != 1 else (arrays[0],)
+        return arrays
     return tuple(a[process_index::process_count] for a in arrays)

@@ -233,7 +233,9 @@ def create_packed_table(
     def init():
         rng = jax.random.PRNGKey(seed)
         # init as if [capacity, dim]: same distribution, packed placement
-        param = access.init_param(rng, (capacity, s * ROW_LANES), dtype)
+        # (fan_in=dim — scaling by the padded width s*128 would start the
+        # table up to 128/dim too small, see test_path_quality)
+        param = access.init_param(rng, (capacity, s * ROW_LANES), dtype, fan_in=dim)
         if init_scale is not None:
             param = param * init_scale
         lane = jnp.arange(s * ROW_LANES) < dim

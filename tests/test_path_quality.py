@@ -1,0 +1,36 @@
+"""Head-to-head quality gate across the word2vec step paths.
+
+The fast paths change semantics — pooled negatives reweight the SGNS
+negative term (``negatives/pool_size`` on a shared pool), and the fused
+kernel is hogwild (racy read-modify-write, the reference's async-SGD
+behavior) — so throughput alone could hide a quality regression. This gate
+trains every path on the SAME structured corpus from the SAME init and
+asserts the learned co-occurrence structure clears the shared bar
+(:mod:`swiftsnails_tpu.framework.quality`, also run on real hardware by
+bench.py so a fast-but-wrong path can't ship a headline number).
+Semantics being approximated: ``merge_push_value``
+(``src/core/parameter/sparsetable.h:176-179``) + per-pair negative draws.
+"""
+
+import pytest
+
+from swiftsnails_tpu.framework.quality import MIN_TOP1, probe_top1
+
+PATHS = {
+    "dense": {"packed": "0"},
+    "packed_perpair": {"packed": "1", "neg_mode": "per_pair"},
+    # pool == batch-block shares 8 negatives over 64 pairs; lam = 4/8
+    "packed_pool": {"packed": "1", "neg_mode": "pool"},
+    # hogwild: within-block duplicate-row races lose some updates
+    "fused": {"packed": "1", "neg_mode": "pool", "fused": "1"},
+}
+
+
+@pytest.mark.parametrize("name", list(PATHS))
+def test_fast_paths_match_reference_quality(name):
+    """Every fast path must learn the pair structure about as well as the
+    reference-faithful dense per-pair path; the absolute bar (shared with
+    bench.py's on-chip gate) means a collapse cannot hide behind a weak
+    reference run."""
+    top1 = probe_top1(PATHS[name])
+    assert top1 >= MIN_TOP1, f"{name}: pair top-1 {top1:.3f} < {MIN_TOP1}"

@@ -14,17 +14,12 @@ from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
 from swiftsnails_tpu.utils.config import Config
 
 
+from swiftsnails_tpu.framework.quality import paired_corpus as _paired_corpus
+
+
 def paired_corpus(n_pairs=8, reps=600, seed=0):
-    """Corpus where word 2i and 2i+1 always co-occur: 'a0 b0 a3 b3 ...'."""
-    rng = np.random.default_rng(seed)
-    vocab_words = [f"w{i}" for i in range(2 * n_pairs)]
-    seq = []
-    for _ in range(reps):
-        pair = rng.integers(0, n_pairs)
-        seq += [2 * pair, 2 * pair + 1]
-    ids = np.array(seq, dtype=np.int32)
-    counts = np.bincount(ids, minlength=2 * n_pairs).astype(np.int64)
-    return ids, Vocab(vocab_words, counts)
+    """Small variant of the shared probe corpus (framework/quality.py)."""
+    return _paired_corpus(n_pairs=n_pairs, reps=reps, seed=seed)
 
 
 def make_trainer(mesh=None, **overrides):

@@ -43,16 +43,23 @@ idx, count = process_info()
 assert count == nproc, (idx, count)
 print(f"process {idx}/{count} joined", flush=True)
 
-# tiny single-process training on this node's data shard (data-parallel
-# across processes is by corpus split, reference stdin-split parity)
+# Every process sees the same logical corpus (seed 0) and trains on ITS
+# contiguous span — the reference's Hadoop stdin-split contract
+# (run_worker.sh: `cat > ./data.txt`), here via shard_token_stream.
 from swiftsnails_tpu.data.vocab import Vocab
 from swiftsnails_tpu.framework.trainer import TrainLoop
 from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+from swiftsnails_tpu.parallel.cluster import shard_token_stream
 
-rng = np.random.default_rng(idx)
+rng = np.random.default_rng(0)
 vocab = Vocab([f"w{i}" for i in range(32)],
               np.maximum(rng.integers(1, 9, 32), 1).astype(np.int64))
-corpus = rng.integers(0, 32, 2000).astype(np.int32)
+full = rng.integers(0, 32, 2000).astype(np.int32)
+corpus = shard_token_stream(full)
+# spans are np.array_split slices: disjoint, contiguous, covering the corpus
+expect = np.array_split(full, nproc)[idx]
+assert np.array_equal(corpus, expect), "wrong shard for this process"
+print(f"process {idx} shard: tokens [{sum(len(s) for s in np.array_split(full, nproc)[:idx])}, +{len(corpus)})", flush=True)
 tcfg = Config({"dim": "8", "window": "2", "negatives": "2",
                "learning_rate": "0.1", "batch_size": "64", "subsample": "0",
                "num_iters": "1", "use_native": "0"})
