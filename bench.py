@@ -103,6 +103,13 @@ def _emit_once(extra_error=None) -> bool:
         return True
 
 
+def _finite(v, ndigits):
+    """round() for JSON: non-finite floats become None (json null)."""
+    import math
+
+    return round(v, ndigits) if isinstance(v, (int, float)) and math.isfinite(v) else None
+
+
 def _result_json(extra_error=None):
     errors = list(_state["errors"])
     if extra_error:
@@ -120,9 +127,11 @@ def _result_json(extra_error=None):
             "baseline_kind": _state["baseline_kind"],
             "path": _state["best_path"],
             "paths": {k: round(v, 1) for k, v in _state["paths"].items()},
-            "quality": {k: round(v, 4) for k, v in _state["quality"].items()},
+            # NaN (failed/skipped probe or diverged loss) -> null: the result
+            # line must stay strict RFC 8259 JSON for the driver
+            "quality": {k: _finite(v, 4) for k, v in _state["quality"].items()},
             "quality_pair_top1": {
-                k: round(v, 3) for k, v in _state["quality_pair_top1"].items()
+                k: _finite(v, 3) for k, v in _state["quality_pair_top1"].items()
             },
             "pairs_per_token": (
                 round(_state["pairs_per_token"], 3)

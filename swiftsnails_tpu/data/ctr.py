@@ -70,6 +70,53 @@ def read_ctr_file(path: str, num_fields: int) -> Tuple[np.ndarray, np.ndarray]:
     )
 
 
+def read_ctr_stream(
+    path: str,
+    num_fields: int,
+    rows_per_chunk: int = 1 << 20,
+    byte_start: int = 0,
+    byte_end: int = 0,
+) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    """Yield (labels, feats) chunks of <= rows_per_chunk records — pure-Python
+    twin of the native streaming reader (bounded memory; Hadoop line-split
+    semantics for a nonzero byte span: a line belongs to the span its first
+    byte falls in)."""
+    labels: List[float] = []
+    rows: List[np.ndarray] = []
+
+    def flush():
+        out = (
+            np.asarray(labels, dtype=np.float32),
+            np.stack(rows) if rows else np.empty((0, num_fields), np.int32),
+        )
+        labels.clear()
+        rows.clear()
+        return out
+
+    with open(path, "rb") as f:
+        if byte_start > 0:
+            f.seek(byte_start - 1)
+            if f.read(1) != b"\n":
+                f.readline()  # partial first line: previous shard's
+        pos = f.tell()
+        while True:
+            if byte_end > 0 and pos >= byte_end:
+                break
+            line = f.readline()
+            if not line:
+                break
+            pos += len(line)
+            rec = parse_record(line.decode("utf-8", "replace"), num_fields)
+            if rec is None:
+                continue
+            labels.append(rec[0])
+            rows.append(rec[1])
+            if len(labels) >= rows_per_chunk:
+                yield flush()
+    if labels:
+        yield flush()
+
+
 def ctr_batches(
     labels: np.ndarray,
     feats: np.ndarray,

@@ -99,6 +99,18 @@ def _load():
         lib.ssn_prefetch_next.restype = c.c_int
         lib.ssn_prefetch_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
         lib.ssn_prefetch_close.argtypes = [c.c_void_p]
+        lib.ssn_vocab_build_stream.restype = c.c_void_p
+        lib.ssn_vocab_build_stream.argtypes = [c.c_char_p, c.c_int, c.c_int]
+        lib.ssn_stream_open.restype = c.c_void_p
+        lib.ssn_stream_open.argtypes = [c.c_void_p, c.c_char_p, c.c_int64, c.c_int64]
+        lib.ssn_stream_next.restype = c.c_int64
+        lib.ssn_stream_next.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
+        lib.ssn_stream_close.argtypes = [c.c_void_p]
+        lib.ssn_ctr_stream_open.restype = c.c_void_p
+        lib.ssn_ctr_stream_open.argtypes = [c.c_char_p, c.c_int, c.c_int64, c.c_int64]
+        lib.ssn_ctr_stream_next.restype = c.c_int64
+        lib.ssn_ctr_stream_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
+        lib.ssn_ctr_stream_close.argtypes = [c.c_void_p]
         _lib = lib
         return _lib
 
@@ -140,12 +152,18 @@ def hash_row(keys: np.ndarray, capacity: int) -> np.ndarray:
 
 
 class NativeVocab:
-    """C++ vocab builder (reference hashmap.h + scan_file_by_line parity)."""
+    """C++ vocab builder (reference hashmap.h + scan_file_by_line parity).
 
-    def __init__(self, path: str, min_count: int = 5, max_size: int = 0):
+    ``stream=True`` (default) reads through a fixed buffer — O(vocab) memory
+    regardless of corpus size, same ordering contract as the whole-file path.
+    """
+
+    def __init__(self, path: str, min_count: int = 5, max_size: int = 0,
+                 stream: bool = True):
         lib = _require()
         self._lib = lib
-        self._h = lib.ssn_vocab_build(path.encode(), min_count, max_size)
+        build = lib.ssn_vocab_build_stream if stream else lib.ssn_vocab_build
+        self._h = build(path.encode(), min_count, max_size)
         if not self._h:
             raise OSError(f"cannot read {path}")
 
@@ -186,6 +204,31 @@ class NativeVocab:
             if got < 0:
                 raise RuntimeError("corpus changed size during encode")
         return out[:got]
+
+    def encode_stream(self, path: str, chunk_tokens: int,
+                      byte_start: int = 0, byte_end: int = 0):
+        """Yield encoded int32 chunks of <= chunk_tokens ids (OOV dropped).
+
+        Bounded memory (one read buffer + one chunk): the streaming twin of
+        :meth:`encode_file` for corpora that don't fit in RAM —
+        ``scan_file_by_line`` parity (src/utils/file.h:11-33). A nonzero
+        ``(byte_start, byte_end)`` reads that span with Hadoop split
+        semantics (a token belongs to the span its first byte falls in), the
+        multi-host stdin-split equivalent.
+        """
+        lib = self._lib
+        h = lib.ssn_stream_open(self._h, path.encode(), byte_start, byte_end)
+        if not h:
+            raise OSError(f"cannot read {path}")
+        try:
+            while True:
+                out = np.empty(chunk_tokens, dtype=np.int32)
+                got = lib.ssn_stream_next(h, _ptr(out), chunk_tokens)
+                if got <= 0:
+                    return
+                yield out[:got]
+        finally:
+            lib.ssn_stream_close(h)
 
     def to_python(self):
         from swiftsnails_tpu.data.vocab import Vocab
@@ -244,6 +287,30 @@ def read_ctr(path: str, num_fields: int) -> Tuple[np.ndarray, np.ndarray]:
     if got < 0:
         raise RuntimeError("file changed size during read")
     return labels[:got], feats[:got]
+
+
+def read_ctr_stream(path: str, num_fields: int, rows_per_chunk: int = 1 << 20,
+                    byte_start: int = 0, byte_end: int = 0):
+    """Yield (labels, feats) chunks of <= rows_per_chunk parsed CTR records.
+
+    Bounded-memory twin of :func:`read_ctr` (line carry across read-buffer
+    edges) — what the Criteo-1TB-scale configs feed from. A nonzero byte
+    span reads that shard with Hadoop line-split semantics.
+    """
+    lib = _require()
+    h = lib.ssn_ctr_stream_open(path.encode(), num_fields, byte_start, byte_end)
+    if not h:
+        raise OSError(f"cannot read {path}")
+    try:
+        while True:
+            labels = np.empty(rows_per_chunk, dtype=np.float32)
+            feats = np.empty((rows_per_chunk, num_fields), dtype=np.int32)
+            got = lib.ssn_ctr_stream_next(h, _ptr(labels), _ptr(feats), rows_per_chunk)
+            if got <= 0:
+                return
+            yield labels[:got], feats[:got]
+    finally:
+        lib.ssn_ctr_stream_close(h)
 
 
 def sgns_train(

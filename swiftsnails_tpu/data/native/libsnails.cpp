@@ -93,19 +93,14 @@ static void for_tokens(const std::string& data, Fn fn) {
   }
 }
 
-extern "C" void* ssn_vocab_build(const char* path, int min_count, int max_size) {
-  std::string data;
-  if (!read_file(path, &data)) return nullptr;
-  std::unordered_map<std::string, int64_t> counter;
-  counter.reserve(1 << 20);
-  for_tokens(data, [&](const char* s, size_t len) {
-    counter[std::string(s, len)] += 1;
-  });
+// Shared ordering contract (identical to Vocab.from_counter): freq desc,
+// then lexicographic, min-count filtered, truncated to max_size.
+static Vocab* make_vocab(std::unordered_map<std::string, int64_t>& counter,
+                         int min_count, int max_size) {
   std::vector<std::pair<std::string, int64_t>> items;
   items.reserve(counter.size());
   for (auto& kv : counter)
     if (kv.second >= min_count) items.emplace_back(kv.first, kv.second);
-  // rank by freq desc then lexicographic — identical to Vocab.build ordering
   std::sort(items.begin(), items.end(), [](const auto& a, const auto& b) {
     if (a.second != b.second) return a.second > b.second;
     return a.first < b.first;
@@ -121,6 +116,17 @@ extern "C" void* ssn_vocab_build(const char* path, int min_count, int max_size) 
     v->index.emplace(items[i].first, (int32_t)i);
   }
   return v;
+}
+
+extern "C" void* ssn_vocab_build(const char* path, int min_count, int max_size) {
+  std::string data;
+  if (!read_file(path, &data)) return nullptr;
+  std::unordered_map<std::string, int64_t> counter;
+  counter.reserve(1 << 20);
+  for_tokens(data, [&](const char* s, size_t len) {
+    counter[std::string(s, len)] += 1;
+  });
+  return make_vocab(counter, min_count, max_size);
 }
 
 extern "C" int64_t ssn_vocab_size(void* h) { return h ? (int64_t)((Vocab*)h)->words.size() : -1; }
@@ -160,6 +166,277 @@ extern "C" int64_t ssn_encode(void* h, const char* path, int32_t* out, int64_t c
   });
   if (out && overflow) return -n;  // caller's buffer was too small
   return n;
+}
+
+// ------------------------------------------------------------ streaming ---
+//
+// Bounded-memory file ingestion (scan_file_by_line / LineFileReader parity,
+// src/utils/file.h:11-33): a fixed read buffer + a carry for the token or
+// line straddling the buffer edge. RSS stays O(buffer + chunk) regardless of
+// file size — the whole-file read_file() paths above are kept for small
+// inputs; these streams are what the 1TB-scale configs feed from.
+
+// defined in the ctr section below; shared with the streaming reader
+static bool parse_ctr_line(const char* q, const char* line_end, int num_fields,
+                           float* label_out, int32_t* feats);
+
+namespace {
+constexpr size_t kStreamBuf = 1 << 20;  // 1 MiB read buffer
+
+struct TokenStream {
+  FILE* f = nullptr;
+  const Vocab* vocab = nullptr;  // borrowed; owner must outlive the stream
+  std::string buf;               // read buffer
+  std::string carry;             // partial token at buffer edge
+  size_t pos = 0;                // cursor into buf
+  bool eof = false;
+  int64_t abs_base = 0;  // file offset of buf[0]
+  int64_t end = 0;       // byte-range shard limit (0 = whole file): a token
+                         // belongs to this shard iff it STARTS before `end`
+                         // (Hadoop split semantics; run_worker.sh parity)
+
+  bool fill() {  // refill buf from file; false at EOF
+    if (eof) return false;
+    abs_base += (int64_t)buf.size();
+    buf.resize(kStreamBuf);
+    size_t got = std::fread(&buf[0], 1, kStreamBuf, f);
+    buf.resize(got);
+    pos = 0;
+    if (got == 0) eof = true;
+    return got > 0;
+  }
+};
+
+struct CtrStream {
+  FILE* f = nullptr;
+  int num_fields = 0;
+  std::string buf;
+  std::string carry;  // partial line at buffer edge
+  size_t pos = 0;
+  bool eof = false;
+  int64_t abs_base = 0;  // file offset of buf[0]
+  int64_t end = 0;       // byte-range limit: a line belongs to the span its
+                         // first byte falls in (Hadoop TextInputFormat)
+};
+}  // namespace
+
+// Open a (byte_start, byte_end) span; 0,0 = whole file. A token straddling
+// byte_start belongs to the PREVIOUS shard (skipped here); a token starting
+// before byte_end is read to completion even past byte_end.
+extern "C" void* ssn_stream_open(void* vocab_h, const char* path,
+                                 int64_t byte_start, int64_t byte_end) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  TokenStream* s = new TokenStream();
+  s->f = f;
+  s->vocab = (const Vocab*)vocab_h;
+  s->end = byte_end;
+  if (byte_start > 0) {
+    // Hadoop convention: a token starting EXACTLY at byte_start is ours iff
+    // the previous byte is whitespace; otherwise we're mid-token and the
+    // owner is the previous shard — skip to the first whitespace.
+    std::fseek(f, (long)(byte_start - 1), SEEK_SET);
+    int prev = std::fgetc(f);
+    s->abs_base = byte_start;
+    if (prev != EOF && !is_space((char)prev)) {
+      for (;;) {
+        if (!s->fill()) break;
+        size_t i = 0;
+        while (i < s->buf.size() && !is_space(s->buf[i])) ++i;
+        if (i < s->buf.size()) { s->pos = i; break; }
+        s->pos = s->buf.size();
+      }
+    }
+  }
+  return s;
+}
+
+// Fill out with up to cap encoded ids (OOV dropped). Returns count written;
+// 0 = end of file. Bounded memory: one read buffer + one partial token.
+extern "C" int64_t ssn_stream_next(void* h, int32_t* out, int64_t cap) {
+  TokenStream* s = (TokenStream*)h;
+  int64_t n = 0;
+  while (n < cap) {
+    if (s->pos >= s->buf.size()) {
+      if (!s->fill()) break;
+    }
+    const char* base = s->buf.data();
+    size_t size = s->buf.size();
+    while (s->pos < size && n < cap) {
+      // skip spaces; a pending carry token ends at the first space
+      if (is_space(base[s->pos])) {
+        if (!s->carry.empty()) {
+          auto it = s->vocab->index.find(s->carry);
+          if (it != s->vocab->index.end()) out[n++] = it->second;
+          s->carry.clear();
+          if (n >= cap) { ++s->pos; break; }
+        }
+        ++s->pos;
+        continue;
+      }
+      // a NEW token starting at/after the shard's byte_end belongs to the
+      // next shard (a carried token started before it — finish that one)
+      if (s->end > 0 && s->carry.empty() &&
+          s->abs_base + (int64_t)s->pos >= s->end) {
+        s->eof = true;
+        break;
+      }
+      size_t start = s->pos;
+      while (s->pos < size && !is_space(base[s->pos])) ++s->pos;
+      if (s->pos >= size) {  // token may continue in the next buffer
+        s->carry.append(base + start, s->pos - start);
+        break;
+      }
+      if (!s->carry.empty()) {
+        s->carry.append(base + start, s->pos - start);
+        auto it = s->vocab->index.find(s->carry);
+        if (it != s->vocab->index.end()) out[n++] = it->second;
+        s->carry.clear();
+      } else {
+        auto it = s->vocab->index.find(std::string(base + start, s->pos - start));
+        if (it != s->vocab->index.end()) out[n++] = it->second;
+      }
+    }
+    if (s->eof) break;
+  }
+  if (s->eof && !s->carry.empty() && n < cap) {  // final unterminated token
+    auto it = s->vocab->index.find(s->carry);
+    if (it != s->vocab->index.end()) out[n++] = it->second;
+    s->carry.clear();
+  }
+  return n;
+}
+
+extern "C" void ssn_stream_close(void* h) {
+  TokenStream* s = (TokenStream*)h;
+  if (s->f) std::fclose(s->f);
+  delete s;
+}
+
+// Streaming vocab build: same ordering contract as ssn_vocab_build, bounded
+// memory (counter is O(vocab), read buffer is fixed).
+extern "C" void* ssn_vocab_build_stream(const char* path, int min_count,
+                                        int max_size) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::unordered_map<std::string, int64_t> counter;
+  counter.reserve(1 << 20);
+  std::string buf;
+  std::string carry;
+  for (;;) {
+    buf.resize(kStreamBuf);
+    size_t got = std::fread(&buf[0], 1, kStreamBuf, f);
+    buf.resize(got);
+    if (got == 0) break;
+    size_t pos = 0;
+    while (pos < got) {
+      if (is_space(buf[pos])) {
+        if (!carry.empty()) { counter[carry] += 1; carry.clear(); }
+        ++pos;
+        continue;
+      }
+      size_t start = pos;
+      while (pos < got && !is_space(buf[pos])) ++pos;
+      if (pos >= got) { carry.append(buf, start, pos - start); break; }
+      if (!carry.empty()) {
+        carry.append(buf, start, pos - start);
+        counter[carry] += 1;
+        carry.clear();
+      } else {
+        counter[std::string(buf, start, pos - start)] += 1;
+      }
+    }
+  }
+  if (!carry.empty()) counter[carry] += 1;
+  std::fclose(f);
+  return make_vocab(counter, min_count, max_size);
+}
+
+extern "C" void* ssn_ctr_stream_open(const char* path, int num_fields,
+                                     int64_t byte_start, int64_t byte_end) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  CtrStream* s = new CtrStream();
+  s->f = f;
+  s->num_fields = num_fields;
+  s->end = byte_end;
+  if (byte_start > 0) {
+    // a line starting exactly at byte_start is ours iff the previous byte
+    // is '\n'; otherwise discard the partial line (previous shard's)
+    std::fseek(f, (long)(byte_start - 1), SEEK_SET);
+    int prev = std::fgetc(f);
+    int64_t skipped = 0;
+    if (prev != EOF && prev != '\n') {
+      int ch;
+      while ((ch = std::fgetc(f)) != EOF) {
+        ++skipped;
+        if (ch == '\n') break;
+      }
+    }
+    s->abs_base = byte_start + skipped;
+  }
+  return s;
+}
+
+// Fill up to max_rows parsed rows (parse_ctr_line is shared with the
+// whole-file ssn_read_ctr above). Returns rows written; 0 = EOF.
+extern "C" int64_t ssn_ctr_stream_next(void* h, float* labels_out,
+                                       int32_t* feats_out, int64_t max_rows) {
+  CtrStream* s = (CtrStream*)h;
+  int64_t row = 0;
+  while (row < max_rows) {
+    if (s->pos >= s->buf.size()) {
+      if (s->eof) break;
+      s->abs_base += (int64_t)s->buf.size();
+      s->buf.resize(kStreamBuf);
+      size_t got = std::fread(&s->buf[0], 1, kStreamBuf, s->f);
+      s->buf.resize(got);
+      s->pos = 0;
+      if (got == 0) { s->eof = true; break; }
+    }
+    // a NEW line starting at/after the span's byte_end belongs to the next
+    // shard (a carried line started before it and is finished normally)
+    if (s->end > 0 && s->carry.empty() &&
+        s->abs_base + (int64_t)s->pos >= s->end) {
+      s->eof = true;
+      break;
+    }
+    const char* base = s->buf.data();
+    const char* end = base + s->buf.size();
+    const char* p = base + s->pos;
+    const char* line_end = (const char*)memchr(p, '\n', (size_t)(end - p));
+    if (!line_end) {  // partial line: carry to the next buffer
+      s->carry.append(p, (size_t)(end - p));
+      s->pos = s->buf.size();
+      continue;
+    }
+    if (!s->carry.empty()) {
+      s->carry.append(p, (size_t)(line_end - p));
+      if (parse_ctr_line(s->carry.data(), s->carry.data() + s->carry.size(),
+                         s->num_fields, labels_out + row,
+                         feats_out + row * s->num_fields))
+        ++row;
+      s->carry.clear();
+    } else if (parse_ctr_line(p, line_end, s->num_fields, labels_out + row,
+                              feats_out + row * s->num_fields)) {
+      ++row;
+    }
+    s->pos = (size_t)(line_end - base) + 1;
+  }
+  if (s->eof && !s->carry.empty() && row < max_rows) {  // final line, no \n
+    if (parse_ctr_line(s->carry.data(), s->carry.data() + s->carry.size(),
+                       s->num_fields, labels_out + row,
+                       feats_out + row * s->num_fields))
+      ++row;
+    s->carry.clear();
+  }
+  return row;
+}
+
+extern "C" void ssn_ctr_stream_close(void* h) {
+  CtrStream* s = (CtrStream*)h;
+  if (s->f) std::fclose(s->f);
+  delete s;
 }
 
 // ------------------------------------------------------------- skip-gram ---
@@ -220,8 +497,42 @@ extern "C" int64_t ssn_subsample(const int32_t* ids, int64_t n, const int64_t* c
 
 // ------------------------------------------------------------------- ctr ---
 
-// Parse "label f0 f1 ..." lines (TextBuffer::get_math parity). PAD = -1.
-// Returns row count; sizes only when outputs are null.
+// Parse one complete "label f0 f1 ..." line (TextBuffer::get_math parity,
+// PAD = -1) into the given row slots. Shared by the whole-file reader and
+// the streaming reader so the two can never drift. Returns false for
+// blank/garbage-label lines (row skipped, strtod-failure semantics).
+static bool parse_ctr_line(const char* q, const char* line_end, int num_fields,
+                           float* label_out, int32_t* feats) {
+  while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+  if (q >= line_end) return false;
+  char* next = nullptr;
+  double label = std::strtod(q, &next);
+  if (next == q) return false;
+  if (label_out) {
+    *label_out = (float)label;
+    for (int fidx = 0; fidx < num_fields; ++fidx) feats[fidx] = -1;
+    const char* cur = next;
+    for (int fidx = 0; fidx < num_fields && cur < line_end; ++fidx) {
+      while (cur < line_end && (*cur == ' ' || *cur == '\t')) ++cur;
+      if (cur >= line_end) break;
+      char* after = nullptr;
+      long v = std::strtol(cur, &after, 10);
+      if (after == cur) break;
+      // "field:id" form — take the id after ':'
+      if (after < line_end && *after == ':') {
+        cur = after + 1;
+        v = std::strtol(cur, &after, 10);
+        if (after == cur) break;
+      }
+      feats[fidx] = (int32_t)v;
+      cur = after;
+    }
+  }
+  return true;
+}
+
+// Parse "label f0 f1 ..." lines. Returns row count; sizes only when outputs
+// are null.
 extern "C" int64_t ssn_read_ctr(const char* path, int num_fields, float* labels_out,
                      int32_t* feats_out, int64_t max_rows) {
   std::string data;
@@ -232,37 +543,13 @@ extern "C" int64_t ssn_read_ctr(const char* path, int num_fields, float* labels_
   while (p < end) {
     const char* line_end = (const char*)memchr(p, '\n', (size_t)(end - p));
     if (!line_end) line_end = end;
-    // skip blank lines
-    const char* q = p;
-    while (q < line_end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
-    if (q < line_end) {
-      char* next = nullptr;
-      double label = std::strtod(q, &next);
-      if (next != q) {
-        if (labels_out) {
-          if (row >= max_rows) return -row;
-          labels_out[row] = (float)label;
-          int32_t* feats = feats_out + (int64_t)row * num_fields;
-          for (int f = 0; f < num_fields; ++f) feats[f] = -1;
-          const char* cur = next;
-          for (int f = 0; f < num_fields && cur < line_end; ++f) {
-            while (cur < line_end && (*cur == ' ' || *cur == '\t')) ++cur;
-            if (cur >= line_end) break;
-            char* after = nullptr;
-            long v = std::strtol(cur, &after, 10);
-            if (after == cur) break;
-            // "field:id" form — take the id after ':'
-            if (after < line_end && *after == ':') {
-              cur = after + 1;
-              v = std::strtol(cur, &after, 10);
-              if (after == cur) break;
-            }
-            feats[f] = (int32_t)v;
-            cur = after;
-          }
-        }
+    if (labels_out) {
+      if (row >= max_rows) return -row;
+      if (parse_ctr_line(p, line_end, num_fields, labels_out + row,
+                         feats_out + row * num_fields))
         ++row;
-      }
+    } else if (parse_ctr_line(p, line_end, num_fields, nullptr, nullptr)) {
+      ++row;
     }
     p = line_end + 1;
   }

@@ -61,6 +61,148 @@ def encode_corpus(
     return ids, vocab
 
 
+_SPACE = b" \t\n\r\v\f"  # the native tokenizer's is_space set
+
+
+def iter_encoded_chunks(
+    path: str,
+    vocab: Vocab,
+    chunk_tokens: int,
+    byte_start: int = 0,
+    byte_end: int = 0,
+    buf_size: int = 1 << 20,
+) -> Iterator[np.ndarray]:
+    """Stream the corpus as encoded int32 chunks of <= chunk_tokens ids.
+
+    Bounded-memory ingestion (``scan_file_by_line`` parity,
+    ``src/utils/file.h:11-33``): RSS is O(read buffer + chunk) regardless of
+    file size; the token straddling a read-buffer edge is carried. A nonzero
+    ``(byte_start, byte_end)`` span applies Hadoop split semantics — a token
+    belongs to the span its FIRST byte falls in (the token straddling
+    ``byte_start`` is the previous shard's; one starting before ``byte_end``
+    is read to completion). Pure-Python twin of the native
+    ``NativeVocab.encode_stream`` (identical id stream, tested).
+    """
+    index = vocab.index
+    chunk: List[int] = []
+
+    def emit(tok: bytes):
+        i = index.get(tok.decode("utf-8", "replace"))
+        if i is not None:
+            chunk.append(i)
+
+    with open(path, "rb") as f:
+        skipping = False
+        if byte_start > 0:
+            f.seek(byte_start - 1)
+            prev = f.read(1)
+            skipping = bool(prev) and prev[0] not in _SPACE
+        abs_base = byte_start
+        carry = b""
+        stop = False
+        while not stop:
+            block = f.read(buf_size)
+            if not block:
+                break
+            pos, n = 0, len(block)
+            while pos < n:
+                if block[pos] in _SPACE:
+                    skipping = False
+                    if carry:
+                        emit(carry)
+                        carry = b""
+                        if len(chunk) >= chunk_tokens:
+                            yield np.asarray(chunk[:chunk_tokens], dtype=np.int32)
+                            chunk = chunk[chunk_tokens:]
+                    pos += 1
+                    continue
+                start = pos
+                while pos < n and block[pos] not in _SPACE:
+                    pos += 1
+                if skipping:
+                    continue  # discarding the pre-byte_start partial token
+                if carry:
+                    carry += block[start:pos]
+                    if pos < n:
+                        emit(carry)
+                        carry = b""
+                else:
+                    if byte_end > 0 and abs_base + start >= byte_end:
+                        stop = True
+                        break
+                    if pos < n:
+                        emit(block[start:pos])
+                    else:
+                        carry = block[start:pos]
+                if len(chunk) >= chunk_tokens:
+                    yield np.asarray(chunk[:chunk_tokens], dtype=np.int32)
+                    chunk = chunk[chunk_tokens:]
+            abs_base += n
+        if carry and not skipping:
+            emit(carry)
+    while chunk:
+        yield np.asarray(chunk[:chunk_tokens], dtype=np.int32)
+        chunk = chunk[chunk_tokens:]
+
+
+def encode_corpus_stream(
+    path: str,
+    chunk_tokens: int,
+    min_count: int = 5,
+    max_vocab: Optional[int] = None,
+    use_native: Optional[bool] = None,
+    byte_start: int = 0,
+    byte_end: int = 0,
+) -> Tuple[Vocab, "object"]:
+    """(vocab, chunk_factory) for bounded-memory training.
+
+    The vocab build streams the WHOLE file once (O(vocab) memory — the vocab
+    must be global so ids and row placement agree across hosts); the
+    returned zero-arg factory opens a fresh encoded-chunk iterator over
+    ``[byte_start, byte_end)`` (0,0 = whole file) — call it once per epoch.
+    Global total tokens for lr-decay progress = ``vocab.counts.sum()``.
+    """
+    from swiftsnails_tpu.data import native
+
+    if use_native is None:
+        use_native = native.available()
+    if use_native:
+        nv = native.NativeVocab(path, min_count=min_count, max_size=max_vocab or 0)
+        py_vocab = nv.to_python()
+
+        def factory():
+            return nv.encode_stream(path, chunk_tokens, byte_start, byte_end)
+
+        return py_vocab, factory
+    # Python fallback: one streaming pass to count, then stream-encode
+    from collections import Counter
+
+    counter: Counter = Counter()
+    buf_size = 1 << 20
+    carry = b""
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(buf_size)
+            if not block:
+                break
+            block = carry + block
+            if block[-1:].isspace():
+                carry = b""
+                parts = block.split()
+            else:
+                parts = block.split()
+                carry = parts.pop() if parts else b""
+            counter.update(t.decode("utf-8", "replace") for t in parts)
+    if carry:
+        counter.update([carry.decode("utf-8", "replace")])
+    vocab = Vocab.from_counter(counter, min_count=min_count, max_size=max_vocab)
+
+    def factory():
+        return iter_encoded_chunks(path, vocab, chunk_tokens, byte_start, byte_end)
+
+    return vocab, factory
+
+
 def iter_line_records(path: str, process_index: int = 0, process_count: int = 1) -> Iterator[str]:
     """Line records, round-robin sharded by process.
 

@@ -25,21 +25,31 @@ class Vocab:
         self.index: Dict[str, int] = {w: i for i, w in enumerate(words)}
 
     @classmethod
-    def build(
+    def from_counter(
         cls,
-        tokens: Iterable[str],
+        counter: Dict[str, int],
         min_count: int = 5,
         max_size: Optional[int] = None,
     ) -> "Vocab":
-        counter = collections.Counter(tokens)
+        """The single source of the ordering contract (also mirrored by the
+        native builder): frequency desc, then lexicographic, min-count
+        filtered, truncated to max_size."""
         items = [(w, c) for w, c in counter.items() if c >= min_count]
-        # rank by frequency desc, then lexicographic for determinism
         items.sort(key=lambda wc: (-wc[1], wc[0]))
         if max_size is not None:
             items = items[:max_size]
         words = [w for w, _ in items]
         counts = np.array([c for _, c in items], dtype=np.int64)
         return cls(words, counts)
+
+    @classmethod
+    def build(
+        cls,
+        tokens: Iterable[str],
+        min_count: int = 5,
+        max_size: Optional[int] = None,
+    ) -> "Vocab":
+        return cls.from_counter(collections.Counter(tokens), min_count, max_size)
 
     def __len__(self) -> int:
         return len(self.words)

@@ -79,8 +79,21 @@ class SparseCTRTrainer(Trainer):
         self.dense_opt = (
             optax.adagrad(self.dense_lr) if opt_name == "adagrad" else optax.sgd(self.dense_lr)
         )
+        # stream: 1 = bounded-memory ingestion: rows are never materialized;
+        # batches() re-opens a chunked reader each epoch (what the
+        # Criteo-1TB-scale configs require).
+        self.stream = cfg.get_bool("stream", False) and data is None
+        self._data_path = None
+        self._byte_span = (0, 0)
         if data is not None:
             self.labels, self.feats = data
+        elif self.stream:
+            self._data_path = cfg.get_str("data")
+            self.labels = self.feats = None
+            if cfg.get_bool("shard_data", True):
+                from swiftsnails_tpu.parallel.cluster import byte_span
+
+                self._byte_span = byte_span(self._data_path)
         else:
             from swiftsnails_tpu.data import native
 
@@ -124,11 +137,33 @@ class SparseCTRTrainer(Trainer):
         opt = self.dense_opt.init(dense)
         return CTRState(table=table, dense=dense, opt=opt)
 
+    def _row_chunks(self, rows_per_chunk: int = 1 << 20):
+        """Streamed (labels, feats) chunks of this process's byte span."""
+        from swiftsnails_tpu.data import native
+        from swiftsnails_tpu.data.ctr import read_ctr_stream as py_stream
+
+        start, end = self._byte_span
+        if self.config.get_bool("use_native", True) and native.available():
+            yield from native.read_ctr_stream(
+                self._data_path, self.num_fields, rows_per_chunk, start, end
+            )
+        else:
+            yield from py_stream(
+                self._data_path, self.num_fields, rows_per_chunk, start, end
+            )
+
     def batches(self) -> Iterator[Dict[str, np.ndarray]]:
         rng = np.random.default_rng(self.seed)
-        yield from ctr_batches(
-            self.labels, self.feats, self.batch_size, rng, epochs=self.epochs
-        )
+        if not self.stream:
+            yield from ctr_batches(
+                self.labels, self.feats, self.batch_size, rng, epochs=self.epochs
+            )
+            return
+        rows_per_chunk = self.config.get_int("rows_per_chunk", 1 << 20)
+        for _ in range(self.epochs):
+            for labels, feats in self._row_chunks(rows_per_chunk):
+                # shuffle within the chunk (bounded-memory shuffle window)
+                yield from ctr_batches(labels, feats, self.batch_size, rng, epochs=1)
 
     def _rows(self, feats: jax.Array) -> jax.Array:
         safe = jnp.maximum(feats, 0)
@@ -170,8 +205,14 @@ class SparseCTRTrainer(Trainer):
         return np.asarray(self.forward(pulled, state.dense, mask))
 
     def eval_auc(self, state: CTRState, labels=None, feats=None, limit: int = 20000) -> float:
-        labels = self.labels[:limit] if labels is None else labels
-        feats = self.feats[:limit] if feats is None else feats
+        if labels is None:
+            if self.stream:  # first `limit` rows of this process's span
+                first = next(iter(self._row_chunks(limit)), None)
+                if first is None:  # empty span (tiny file, many hosts)
+                    return 0.5
+                labels, feats = first
+            else:
+                labels, feats = self.labels[:limit], self.feats[:limit]
         return auc_score(labels, self.predict(state, feats))
 
     def export_text(self, state: CTRState, path: str) -> None:

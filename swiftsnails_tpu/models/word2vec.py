@@ -165,22 +165,52 @@ class Word2VecTrainer(Trainer):
             raise ValueError("push_mode: bucketed requires packed: 1 without fused: 1")
         self.bucket_slack = cfg.get_float("bucket_slack", 2.0)
 
+        # stream: 1 = bounded-memory ingestion — the corpus is never
+        # materialized; batches() re-opens a chunk stream each epoch
+        # (scan_file_by_line parity; required for corpora larger than RAM).
+        self.stream = cfg.get_bool("stream", False)
+        self._chunk_factory = None
+        self._local_total = None  # approx local tokens/epoch (progress denom)
         if corpus_ids is None:
             data_path = cfg.get_str("data")
-            corpus_ids, vocab = encode_corpus(
-                data_path,
-                min_count=cfg.get_int("min_count", 5),
-                max_vocab=cfg.get_int("max_vocab", 0) or None,
-            )
-            # Multi-host: train on this process's contiguous corpus span
-            # (stdin-split parity; vocab stays global so ids/placement agree
-            # across hosts). shard_data: 0 restores every-host-trains-all.
-            if cfg.get_bool("shard_data", True):
-                from swiftsnails_tpu.parallel.cluster import shard_token_stream
+            if self.stream:
+                from swiftsnails_tpu.data.text import encode_corpus_stream
+                from swiftsnails_tpu.parallel.cluster import byte_span, process_info
 
-                corpus_ids = shard_token_stream(corpus_ids)
+                span = (0, 0)
+                n_proc = 1
+                if cfg.get_bool("shard_data", True):
+                    span = byte_span(data_path)
+                    n_proc = process_info()[1]
+                vocab, self._chunk_factory = encode_corpus_stream(
+                    data_path,
+                    self.chunk_tokens,
+                    min_count=cfg.get_int("min_count", 5),
+                    max_vocab=cfg.get_int("max_vocab", 0) or None,
+                    byte_start=span[0],
+                    byte_end=span[1],
+                )
+                # even byte spans => ~even token spans (progress denominator)
+                self._local_total = max(int(vocab.counts.sum()) // n_proc, 1)
+            else:
+                corpus_ids, vocab = encode_corpus(
+                    data_path,
+                    min_count=cfg.get_int("min_count", 5),
+                    max_vocab=cfg.get_int("max_vocab", 0) or None,
+                )
+                # Multi-host: train on this process's contiguous corpus span
+                # (stdin-split parity; vocab stays global so ids/placement
+                # agree across hosts). shard_data: 0 = every host trains all.
+                if cfg.get_bool("shard_data", True):
+                    from swiftsnails_tpu.parallel.cluster import shard_token_stream
+
+                    corpus_ids = shard_token_stream(corpus_ids)
         assert vocab is not None, "vocab required when corpus_ids is given"
-        self.corpus_ids = np.asarray(corpus_ids, dtype=np.int32)
+        if corpus_ids is not None:
+            self.corpus_ids = np.asarray(corpus_ids, dtype=np.int32)
+            self._local_total = len(self.corpus_ids)
+        else:
+            self.corpus_ids = None
         self.vocab = vocab
         cap = cfg.get_int("capacity", 0) or _next_pow2(max(len(vocab), 2))
         self.capacity = cap
@@ -242,17 +272,35 @@ class Word2VecTrainer(Trainer):
 
     # -- data --------------------------------------------------------------
 
+    def _epoch_chunks(self) -> Iterator[np.ndarray]:
+        """Token chunks for one epoch: corpus slices, or the bounded-memory
+        stream (re-opened per epoch) in ``stream: 1`` mode."""
+        if self.corpus_ids is not None:
+            ids = self.corpus_ids
+            for start in range(0, len(ids), self.chunk_tokens):
+                yield ids[start : start + self.chunk_tokens]
+        else:
+            yield from self._chunk_factory()
+
     def batches(self) -> Iterator[Dict[str, np.ndarray]]:
         from swiftsnails_tpu.data import native
 
         use_native = self.config.get_bool("use_native", True) and native.available()
         rng = np.random.default_rng(self.seed)
         counts = self.vocab.counts
+        # progress = fraction of this process's corpus consumed (raw tokens x
+        # epochs, the word2vec.c word_count convention) — drives linear lr
+        # decay. In stream mode the denominator is the byte-span-estimated
+        # local token count (exact for the non-streaming path).
+        local_total = max(self._local_total or 1, 1)
+        total_tokens = max(self.epochs * local_total, 1)
         for epoch in range(self.epochs):
-            ids = self.corpus_ids
-            for start in range(0, len(ids), self.chunk_tokens):
-                chunk = ids[start : start + self.chunk_tokens]
-                seed = (self.seed * 1_000_003 + epoch * 7919 + start) & 0xFFFFFFFF
+            consumed = 0  # local tokens before this chunk
+            for chunk in self._epoch_chunks():
+                seed = (self.seed * 1_000_003 + epoch * 7919 + consumed) & 0xFFFFFFFF
+                chunk_base = epoch * local_total + consumed
+                chunk_len = len(chunk)
+                consumed += chunk_len
                 if use_native:
                     if self.subsample > 0:
                         chunk = native.subsample(chunk, counts, self.subsample, seed=seed)
@@ -263,13 +311,7 @@ class Word2VecTrainer(Trainer):
                     if self.subsample > 0:
                         chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
                     centers, contexts = skipgram_pairs(chunk, self.window, rng)
-                # macro-batches: steps_per_call optimizer steps per dispatch.
-                # progress = fraction of total corpus tokens consumed (raw
-                # tokens x epochs, the word2vec.c word_count convention) —
-                # drives linear lr decay when lr_decay is on.
-                total_tokens = max(self.epochs * len(ids), 1)
-                chunk_base = epoch * len(ids) + start
-                chunk_len = len(ids[start : start + self.chunk_tokens])
+                # macro-batches: steps_per_call optimizer steps per dispatch
                 macro = self.batch_size * self.steps_per_call
                 n_batches = max(len(centers) // macro, 1)
                 for bi, b in enumerate(

@@ -111,6 +111,35 @@ def shard_token_stream(
     return np.array_split(ids, process_count)[process_index]
 
 
+def byte_span(
+    path: str,
+    process_index: Optional[int] = None,
+    process_count: Optional[int] = None,
+) -> Tuple[int, int]:
+    """This process's contiguous [start, end) byte span of a corpus file.
+
+    For streaming ingestion: each host reads ONLY its span (Hadoop input-
+    split parity — the reference's workers got their split on stdin,
+    ``run_worker.sh``). Token-boundary adjustment happens in the stream
+    readers (a token belongs to the span its first byte falls in).
+    Returns (0, 0) — whole file — for a single process.
+    """
+    import os
+
+    if process_count is None:
+        process_index, process_count = process_info()
+    if process_count <= 1:
+        return 0, 0
+    size = os.path.getsize(path)
+    # per >= 1 and clamped ends: with size < process_count the surplus
+    # processes get an EMPTY [size, size) span, never the (0, 0)
+    # whole-file sentinel (which would silently duplicate the corpus)
+    per = max(size // process_count, 1)
+    start = min(process_index * per, size)
+    end = size if process_index == process_count - 1 else min(start + per, size)
+    return start, end
+
+
 def shard_rows(
     *arrays: np.ndarray,
     process_index: Optional[int] = None,

@@ -45,6 +45,27 @@ def test_shard_token_stream_spans():
     np.testing.assert_array_equal(shard_token_stream(ids, 0, 1), ids)
 
 
+def test_byte_span_partition_and_degenerate(tmp_path):
+    from swiftsnails_tpu.parallel.cluster import byte_span
+
+    p = tmp_path / "f.txt"
+    p.write_bytes(b"x" * 100)
+    # normal: disjoint, covering, last takes the remainder
+    spans = [byte_span(str(p), i, 3) for i in range(3)]
+    assert spans == [(0, 33), (33, 66), (66, 100)]
+    # single process: whole-file sentinel
+    assert byte_span(str(p), 0, 1) == (0, 0)
+    # size < process_count: surplus processes get EMPTY spans, never the
+    # (0, 0) whole-file sentinel (which would duplicate the corpus)
+    spans = [byte_span(str(p), i, 128) for i in range(128)]
+    for i, (lo, hi) in enumerate(spans):
+        assert (lo, hi) != (0, 0) or i == -1
+        assert 0 <= lo <= hi <= 100
+    covered = sorted(s for s in spans if s[0] < s[1])
+    assert covered[0][0] == 0 and covered[-1][1] == 100
+    assert all(a[1] == b[0] for a, b in zip(covered[:-1], covered[1:]))
+
+
 def test_shard_rows_round_robin():
     labels = np.arange(10)
     feats = np.arange(20).reshape(10, 2)

@@ -1,0 +1,266 @@
+"""Streaming (bounded-memory) ingestion: native + Python twins.
+
+scan_file_by_line parity (``src/utils/file.h:11-33``): corpora and CTR files
+larger than RAM are read through a fixed buffer with token/line carry at the
+edges, optionally restricted to a [byte_start, byte_end) shard with Hadoop
+split semantics (a token/line belongs to the span its first byte falls in —
+the multi-host stdin-split equivalent, ``run_worker.sh``).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from swiftsnails_tpu.data import native
+from swiftsnails_tpu.data.ctr import read_ctr_file, read_ctr_stream
+from swiftsnails_tpu.data.text import (
+    encode_corpus,
+    encode_corpus_stream,
+    iter_encoded_chunks,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def corpus_file(tmp_path_factory):
+    """~3 MB corpus: larger than the 1 MiB stream buffer, so token carry at
+    buffer edges is exercised; includes multi-space and newline separators."""
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(500)]
+    path = tmp_path_factory.mktemp("stream") / "corpus.txt"
+    with open(path, "w") as f:
+        n = 0
+        while n < 3_000_000:
+            k = int(rng.integers(5, 15))
+            line = " ".join(words[i] for i in rng.integers(0, 500, k))
+            sep = "\n" if rng.random() < 0.9 else "  \t "
+            f.write(line + sep)
+            n += len(line) + len(sep)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def full_ids(corpus_file):
+    ids, vocab = encode_corpus(corpus_file, min_count=1, use_native=False)
+    return ids, vocab
+
+
+def test_python_stream_matches_whole_file(corpus_file, full_ids):
+    ids, vocab = full_ids
+    chunks = list(iter_encoded_chunks(corpus_file, vocab, chunk_tokens=10_000))
+    got = np.concatenate(chunks)
+    assert all(len(c) <= 10_000 for c in chunks)
+    np.testing.assert_array_equal(got, ids)
+
+
+def test_python_stream_small_buffer_carry(corpus_file, full_ids):
+    """A tiny read buffer forces token carry at nearly every edge."""
+    ids, vocab = full_ids
+    got = np.concatenate(
+        list(iter_encoded_chunks(corpus_file, vocab, 7_777, buf_size=1013))
+    )
+    np.testing.assert_array_equal(got, ids)
+
+
+def test_python_byte_spans_partition(corpus_file, full_ids):
+    """Concatenating the spans' streams reproduces the full id stream exactly
+    — every token to exactly one span, even when cuts land mid-token."""
+    ids, vocab = full_ids
+    size = os.path.getsize(corpus_file)
+    cuts = [0, size // 3 + 1, 2 * size // 3 - 5, size]
+    parts = []
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        parts.extend(iter_encoded_chunks(corpus_file, vocab, 10_000, lo, hi))
+    np.testing.assert_array_equal(np.concatenate(parts), ids)
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_stream_matches_whole_file(corpus_file):
+    nv = native.NativeVocab(corpus_file, min_count=1)
+    want = nv.encode_file(corpus_file)
+    got = np.concatenate(list(nv.encode_stream(corpus_file, 10_000)))
+    np.testing.assert_array_equal(got, want)
+    nv.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_stream_vocab_matches_whole_file_vocab(corpus_file):
+    sv = native.NativeVocab(corpus_file, min_count=2, stream=True)
+    wv = native.NativeVocab(corpus_file, min_count=2, stream=False)
+    assert sv.words() == wv.words()
+    np.testing.assert_array_equal(sv.counts(), wv.counts())
+    sv.close(), wv.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_byte_spans_partition(corpus_file):
+    nv = native.NativeVocab(corpus_file, min_count=1)
+    want = nv.encode_file(corpus_file)
+    size = os.path.getsize(corpus_file)
+    cuts = [0, size // 4 + 3, size // 2, 3 * size // 4 - 7, size]
+    parts = []
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        parts.extend(nv.encode_stream(corpus_file, 10_000, lo, hi))
+    np.testing.assert_array_equal(np.concatenate(parts), want)
+    nv.close()
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_native_python_streams_agree(corpus_file, full_ids):
+    ids, vocab = full_ids
+    nv = native.NativeVocab(corpus_file, min_count=1)
+    got = np.concatenate(list(nv.encode_stream(corpus_file, 9_999)))
+    np.testing.assert_array_equal(got, ids)
+    nv.close()
+
+
+# ------------------------------------------------------------------- ctr ---
+
+
+@pytest.fixture(scope="module")
+def ctr_file(tmp_path_factory):
+    rng = np.random.default_rng(1)
+    path = tmp_path_factory.mktemp("ctr") / "train.txt"
+    with open(path, "w") as f:
+        for _ in range(5000):
+            label = int(rng.random() < 0.3)
+            feats = " ".join(str(int(x)) for x in rng.integers(0, 10_000, 4))
+            f.write(f"{label} {feats}\n")
+    return str(path)
+
+
+def test_ctr_python_stream_matches_whole_file(ctr_file):
+    labels, feats = read_ctr_file(ctr_file, 4)
+    parts = list(read_ctr_stream(ctr_file, 4, rows_per_chunk=777))
+    np.testing.assert_array_equal(np.concatenate([l for l, _ in parts]), labels)
+    np.testing.assert_array_equal(np.concatenate([f for _, f in parts]), feats)
+
+
+def test_ctr_python_byte_spans_partition(ctr_file):
+    labels, feats = read_ctr_file(ctr_file, 4)
+    size = os.path.getsize(ctr_file)
+    cuts = [0, size // 3 + 2, 2 * size // 3 - 1, size]
+    ls, fs = [], []
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        for l, f in read_ctr_stream(ctr_file, 4, 1000, lo, hi):
+            ls.append(l)
+            fs.append(f)
+    np.testing.assert_array_equal(np.concatenate(ls), labels)
+    np.testing.assert_array_equal(np.concatenate(fs), feats)
+
+
+@pytest.mark.skipif(not native.available(), reason="native toolchain unavailable")
+def test_ctr_native_stream_and_spans(ctr_file):
+    labels, feats = read_ctr_file(ctr_file, 4)
+    parts = list(native.read_ctr_stream(ctr_file, 4, rows_per_chunk=997))
+    np.testing.assert_array_equal(np.concatenate([l for l, _ in parts]), labels)
+    np.testing.assert_array_equal(np.concatenate([f for _, f in parts]), feats)
+    size = os.path.getsize(ctr_file)
+    cuts = [0, size // 2 + 13, size]
+    ls = []
+    for lo, hi in zip(cuts[:-1], cuts[1:]):
+        for l, _ in native.read_ctr_stream(ctr_file, 4, 1000, lo, hi):
+            ls.append(l)
+    np.testing.assert_array_equal(np.concatenate(ls), labels)
+
+
+# -------------------------------------------------------------- trainers ---
+
+
+def test_word2vec_stream_mode_matches_materialized(corpus_file):
+    """stream: 1 produces the same encoded chunk sequence as slicing the
+    materialized corpus, and trains end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    base = {
+        "data": corpus_file, "dim": "8", "window": "2", "negatives": "2",
+        "learning_rate": "0.1", "batch_size": "256", "subsample": "0",
+        "num_iters": "1", "min_count": "1", "chunk_tokens": "50000",
+    }
+    tr_mat = Word2VecTrainer(Config(dict(base)), mesh=None)
+    tr_st = Word2VecTrainer(Config({**base, "stream": "1"}), mesh=None)
+    assert tr_st.corpus_ids is None and tr_st.stream
+    mat_chunks = list(tr_mat._epoch_chunks())
+    st_chunks = list(tr_st._epoch_chunks())
+    np.testing.assert_array_equal(
+        np.concatenate(mat_chunks), np.concatenate(st_chunks)
+    )
+    state = tr_st.init_state()
+    step = jax.jit(tr_st.train_step, donate_argnums=(0,))
+    for i, batch in enumerate(tr_st.batches()):
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.PRNGKey(i))
+        if i >= 2:
+            break
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_ctr_trainer_stream_mode(ctr_file):
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.models.registry import get_model
+    from swiftsnails_tpu.utils.config import Config
+
+    cfg = Config({
+        "data": ctr_file, "model": "logreg", "num_fields": "4",
+        "capacity": "16384", "batch_size": "256", "num_iters": "1",
+        "learning_rate": "0.1", "stream": "1", "rows_per_chunk": "1024",
+    })
+    tr = get_model("logreg")(cfg, mesh=None)
+    assert tr.stream and tr.labels is None
+    state = tr.init_state()
+    step = jax.jit(tr.train_step, donate_argnums=(0,))
+    n = 0
+    for batch in tr.batches():
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.PRNGKey(n))
+        n += 1
+    assert n == 5000 // 256 * 1  # chunked (1024-row windows), same batches
+    assert np.isfinite(float(m["loss"]))
+    auc = tr.eval_auc(state)
+    assert 0.0 <= auc <= 1.0
+
+
+def test_streaming_encode_constant_rss(tmp_path):
+    """Peak RSS while stream-encoding a file stays far below the file size
+    (the whole-file path would hold file + ids in memory)."""
+    path = tmp_path / "big.txt"
+    rng = np.random.default_rng(2)
+    with open(path, "w") as f:
+        for _ in range(80):
+            f.write(" ".join(f"w{i}" for i in rng.integers(0, 200, 80_000)))
+            f.write("\n")
+    size = os.path.getsize(path)
+    assert size > 24_000_000  # ~28 MB
+    code = f"""
+import resource, sys, numpy as np
+sys.path.insert(0, {REPO!r})
+from swiftsnails_tpu.data.text import encode_corpus_stream
+vocab, factory = encode_corpus_stream({str(path)!r}, chunk_tokens=100_000,
+                                      min_count=1, use_native=False)
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+total = 0
+for chunk in factory():
+    total += len(chunk)
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+assert total == int(vocab.counts.sum()), (total, int(vocab.counts.sum()))
+print(base, peak)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    base_kb, peak_kb = map(int, proc.stdout.split()[-2:])
+    delta = (peak_kb - base_kb) * 1024
+    # encode added < 1/3 of the file size to peak RSS (buffer + one chunk);
+    # a whole-file encode would add >= file size
+    assert delta < size // 3, (delta, size)
