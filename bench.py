@@ -78,6 +78,7 @@ _state = {
     "baseline_node": None,  # per-node words/sec
     "baseline_kind": None,  # "c-loop" | "numpy"
     "pairs_per_token": None,
+    "input_words_per_sec": None,  # host pipeline rate (words/sec equivalent)
     "platform": None,
     "errors": [],
 }
@@ -138,6 +139,7 @@ def _result_json(extra_error=None):
                 if _state["pairs_per_token"]
                 else None
             ),
+            "input_words_per_sec": _finite(_state["input_words_per_sec"] or 0, 1) or None,
             "platform": _state["platform"],
             "elapsed_s": round(time.monotonic() - _T0, 1),
             "errors": errors,
@@ -419,6 +421,32 @@ def measure_tpu_paths(counts, batches, pairs_per_token):
         )
 
 
+def measure_input_pipeline(ids, pairs_per_token: float) -> None:
+    """Host-side input rate: tokens -> pairs -> shuffled macro-batches.
+
+    The native chunk path (skipgram pairgen + C++ PairPrefetcher, the
+    product path in Word2VecTrainer.batches). Recorded as words/sec so it
+    compares directly against the device rate: the pipeline must sustain
+    the chip (survey build item 7) or the bench flags it.
+    """
+    from swiftsnails_tpu.data import native
+
+    if not native.available():
+        _state["errors"].append("input pipeline not measured (no native lib)")
+        return
+    t0 = time.perf_counter()
+    centers, contexts = native.skipgram_pairs(ids, WINDOW, seed=11)
+    pf = native.PairPrefetcher(
+        centers, contexts, BATCH * STEPS_PER_CALL, epochs=1, capacity=8, seed=11
+    )
+    n_pairs = 0
+    for b in pf:
+        n_pairs += b["centers"].size
+    pf.close()
+    dt = time.perf_counter() - t0
+    _state["input_words_per_sec"] = n_pairs / dt / pairs_per_token
+
+
 def measure_cpu_baseline(batches, pairs_per_token: float, counts) -> None:
     """Calibrated per-node CPU PS worker rate, words/sec.
 
@@ -535,6 +563,19 @@ def main():
 
     # 3. TPU paths, safest first; best-so-far survives any later hang.
     measure_tpu_paths(counts, batches, pairs_per_token)
+
+    # 4. Host input-pipeline rate must sustain the device rate. Never let a
+    #    pipeline-measurement failure discard the measured device result.
+    try:
+        measure_input_pipeline(ids, pairs_per_token)
+    except Exception as e:
+        _state["errors"].append(f"input pipeline measurement failed: {e}")
+    in_rate = _state["input_words_per_sec"]
+    if in_rate and _state["best"] and in_rate < _state["best"]:
+        _state["errors"].append(
+            f"input pipeline ({in_rate:,.0f} words/s) below device rate "
+            f"({_state['best']:,.0f} words/s): host-bound at full scale"
+        )
 
     _emit_once()
     return 0 if _state["best"] > 0 else 1

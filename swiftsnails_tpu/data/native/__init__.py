@@ -60,6 +60,18 @@ def _load():
             return None
         lib = ctypes.CDLL(_SO)
         c = ctypes
+        try:
+            _bind(lib, c)
+        except AttributeError as e:
+            # e.g. SSN_NATIVE_SO pointing at a build of older source: treat
+            # as unavailable (callers fall back to Python) instead of raising
+            _build_error = f"native library missing symbols (stale build?): {e}"
+            return None
+        _lib = lib
+        return _lib
+
+
+def _bind(lib, c):
         lib.ssn_murmur64.argtypes = [c.c_void_p, c.c_void_p, c.c_int64]
         lib.ssn_hash_row.argtypes = [c.c_void_p, c.c_int64, c.c_uint64, c.c_void_p]
         lib.ssn_vocab_build.restype = c.c_void_p
@@ -111,8 +123,6 @@ def _load():
         lib.ssn_ctr_stream_next.restype = c.c_int64
         lib.ssn_ctr_stream_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
         lib.ssn_ctr_stream_close.argtypes = [c.c_void_p]
-        _lib = lib
-        return _lib
 
 
 def available() -> bool:

@@ -543,12 +543,14 @@ extern "C" int64_t ssn_read_ctr(const char* path, int num_fields, float* labels_
   while (p < end) {
     const char* line_end = (const char*)memchr(p, '\n', (size_t)(end - p));
     if (!line_end) line_end = end;
-    if (labels_out) {
-      if (row >= max_rows) return -row;
-      if (parse_ctr_line(p, line_end, num_fields, labels_out + row,
-                         feats_out + row * num_fields))
-        ++row;
-    } else if (parse_ctr_line(p, line_end, num_fields, nullptr, nullptr)) {
+    // validate first (label-only parse): blank/garbage lines after the last
+    // valid row must NOT trip the overflow check
+    if (parse_ctr_line(p, line_end, num_fields, nullptr, nullptr)) {
+      if (labels_out) {
+        if (row >= max_rows) return -row;
+        parse_ctr_line(p, line_end, num_fields, labels_out + row,
+                       feats_out + row * num_fields);
+      }
       ++row;
     }
     p = line_end + 1;

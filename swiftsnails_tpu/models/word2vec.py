@@ -311,14 +311,28 @@ class Word2VecTrainer(Trainer):
                     if self.subsample > 0:
                         chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
                     centers, contexts = skipgram_pairs(chunk, self.window, rng)
-                # macro-batches: steps_per_call optimizer steps per dispatch
+                # macro-batches: steps_per_call optimizer steps per dispatch.
+                # Native path: the C++ PairPrefetcher shuffles and slices in
+                # a producer thread behind a bounded queue
+                # (queue_with_capacity parity, src/utils/queue.h:100-108), so
+                # batch assembly overlaps device compute instead of running
+                # on the dispatch thread.
                 macro = self.batch_size * self.steps_per_call
                 n_batches = max(len(centers) // macro, 1)
-                for bi, b in enumerate(
-                    batch_stream(centers, contexts, macro, rng)
-                ):
-                    p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
-                    yield {**b, "progress": np.float32(min(p, 1.0))}
+                if use_native and len(centers) >= macro:
+                    stream = native.PairPrefetcher(
+                        centers, contexts, macro, epochs=1, capacity=4,
+                        seed=seed,
+                    )
+                else:
+                    stream = batch_stream(centers, contexts, macro, rng)
+                try:
+                    for bi, b in enumerate(stream):
+                        p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
+                        yield {**b, "progress": np.float32(min(p, 1.0))}
+                finally:
+                    if hasattr(stream, "close"):
+                        stream.close()
 
     # -- step --------------------------------------------------------------
 

@@ -140,3 +140,51 @@ def test_sgns_train_learns_structure():
     within = (logits[:half, :half].mean() + logits[half:, half:].mean()) / 2
     cross = (logits[:half, half:].mean() + logits[half:, :half].mean()) / 2
     assert within > cross + 0.5, (within, cross)
+
+
+def test_trainer_batches_use_pair_prefetcher(monkeypatch):
+    """Word2VecTrainer.batches() routes macro-batch assembly through the C++
+    PairPrefetcher when the native pipeline is available (survey build item
+    7: the input pipeline must sustain the device rate)."""
+    import swiftsnails_tpu.data.native as native_mod
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    if not native_mod.available():
+        pytest.skip("native toolchain unavailable")
+    made = []
+    real = native_mod.PairPrefetcher
+
+    class Spy(real):
+        def __init__(self, *a, **k):
+            made.append(a)
+            super().__init__(*a, **k)
+
+    monkeypatch.setattr(native_mod, "PairPrefetcher", Spy)
+    rng = np.random.default_rng(0)
+    vocab = Vocab([f"w{i}" for i in range(32)],
+                  np.maximum(rng.integers(1, 9, 32), 1).astype(np.int64))
+    corpus = rng.integers(0, 32, 4000).astype(np.int32)
+    tr = Word2VecTrainer(
+        Config({"dim": "8", "window": "2", "negatives": "2",
+                "learning_rate": "0.1", "batch_size": "64", "subsample": "0",
+                "num_iters": "1"}),
+        mesh=None, corpus_ids=corpus, vocab=vocab,
+    )
+    batches = list(tr.batches())
+    assert made, "PairPrefetcher was not used by batches()"
+    assert all(b["centers"].shape[0] == 64 for b in batches)
+
+
+def test_read_ctr_trailing_blank_lines(tmp_path):
+    """Blank/garbage lines after the last valid row must not trip the
+    overflow check (regression: the fill pass returned -row and the wrapper
+    raised 'file changed size during read')."""
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+    p = tmp_path / "t.txt"
+    p.write_text("1 2 3\n0 4 5\n\n  \n# junk\n")
+    labels, feats = native.read_ctr(str(p), 2)
+    assert labels.shape == (2,)
+    np.testing.assert_array_equal(feats, [[2, 3], [4, 5]])
