@@ -99,17 +99,74 @@ def skipgram_pairs(
     dynamic window) selects neighbors at offsets ``-b..-1, 1..b``. Returns
     int32 arrays (centers, contexts).
     """
+    pos, valid = _dynamic_window_valid(ids, window, rng, dynamic)
+    if pos is None:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    n = len(ids)
+    centers = np.repeat(np.arange(n), valid.sum(axis=1))
+    contexts = pos[valid]
+    return ids[centers].astype(np.int32), ids[contexts].astype(np.int32)
+
+
+def _dynamic_window_valid(ids, window, rng, dynamic):
+    """Shared dynamic-window geometry: (pos [n, 2w], valid [n, 2w]).
+
+    The single source of the b ~ U(1, window) draw and boundary clipping —
+    skipgram_pairs and skipgram_windows MUST generate the same pair set
+    (flat vs grouped quality comparisons depend on it)."""
     n = len(ids)
     if n < 2:
-        return np.empty(0, np.int32), np.empty(0, np.int32)
+        return None, None
     b = rng.integers(1, window + 1, size=n) if dynamic else np.full(n, window)
     offsets = np.arange(-window, window + 1)
     offsets = offsets[offsets != 0]  # [2w]
     pos = np.arange(n)[:, None] + offsets[None, :]  # [n, 2w]
     valid = (pos >= 0) & (pos < n) & (np.abs(offsets)[None, :] <= b[:, None])
-    centers = np.repeat(np.arange(n), valid.sum(axis=1))
-    contexts = pos[valid]
-    return ids[centers].astype(np.int32), ids[contexts].astype(np.int32)
+    return pos, valid
+
+
+def skipgram_windows(
+    ids: np.ndarray,
+    window: int,
+    rng: np.random.Generator,
+    dynamic: bool = True,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Center-major skip-gram: ``(centers [n], contexts [n, 2*window])``.
+
+    Same pair set as :func:`skipgram_pairs` (identical dynamic-window draw),
+    but grouped by center position with ``-1`` padding in unused context
+    slots — the layout of word2vec.c's inner loop, and what the grouped
+    fused kernel consumes (the center row is loaded ONCE for its whole
+    window instead of once per pair; the per-row copy issue rate is the
+    kernel's bound).
+    """
+    n = len(ids)
+    cw = 2 * window
+    pos, valid = _dynamic_window_valid(ids, window, rng, dynamic)
+    if pos is None:
+        return np.empty(0, np.int32), np.empty((0, cw), np.int32)
+    ctxs = np.where(valid, ids[np.clip(pos, 0, n - 1)], -1).astype(np.int32)
+    return ids.astype(np.int32, copy=True), ctxs
+
+
+def window_batch_stream(
+    centers: np.ndarray,
+    ctxs: np.ndarray,
+    batch_size: int,
+    rng: np.random.Generator,
+    shuffle: bool = True,
+):
+    """Yield {'centers' [B], 'contexts' [B, CW]} batches (drop remainder).
+
+    Shuffles CENTERS (whole windows move together) — pair order inside a
+    window stays sequential, word2vec.c-style.
+    """
+    n = len(centers)
+    order = rng.permutation(n) if shuffle else np.arange(n)
+    end = (n // batch_size) * batch_size
+    for start in range(0, end, batch_size):
+        sel = order[start : start + batch_size]
+        yield {"centers": centers[sel], "contexts": ctxs[sel]}
 
 
 def batch_stream(

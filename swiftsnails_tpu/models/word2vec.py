@@ -34,7 +34,9 @@ from swiftsnails_tpu.data.sampler import (
     batch_stream,
     build_unigram_alias,
     skipgram_pairs,
+    skipgram_windows,
     subsample_mask,
+    window_batch_stream,
 )
 from swiftsnails_tpu.data.text import encode_corpus
 from swiftsnails_tpu.data.vocab import Vocab
@@ -140,6 +142,15 @@ class Word2VecTrainer(Trainer):
             and self.neg_mode == "pool"
             and mesh is None
         )
+        # grouped: 1 -> center-major fused kernel (word2vec.c loop order: one
+        # center-row DMA per window instead of per pair; the per-row copy
+        # issue rate is the fused kernel's measured bound). Batches switch to
+        # the {"centers" [N], "contexts" [N, 2*window]} window schema.
+        self.grouped = cfg.get_bool("grouped", False) and self.fused
+        if cfg.get_bool("grouped", False) and not cfg.get_bool("fused", False):
+            raise ValueError("grouped: 1 requires fused: 1")
+        # centers per kernel block; per-substep center count is batch_size
+        self.centers_per_block = cfg.get_int("centers_per_block", 256)
         if self.fused and self.lr_decay:
             # the fused kernel bakes lr in at Mosaic compile time
             # (ops/fused_sgns.py static_argnames); a traced decayed lr
@@ -304,12 +315,26 @@ class Word2VecTrainer(Trainer):
                 if use_native:
                     if self.subsample > 0:
                         chunk = native.subsample(chunk, counts, self.subsample, seed=seed)
+                elif self.subsample > 0:
+                    chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
+                if self.grouped:
+                    # center-major window schema for the grouped kernel; one
+                    # batch row = one corpus position (word), whole windows
+                    # shuffle together (word2vec.c pair order within)
+                    g_c, g_x = skipgram_windows(chunk, self.window, rng)
+                    macro = self.batch_size * self.steps_per_call
+                    n_batches = max(len(g_c) // macro, 1)
+                    for bi, b in enumerate(
+                        window_batch_stream(g_c, g_x, macro, rng)
+                    ):
+                        p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
+                        yield {**b, "progress": np.float32(min(p, 1.0))}
+                    continue
+                if use_native:
                     centers, contexts = native.skipgram_pairs(
                         chunk, self.window, seed=seed
                     )
                 else:
-                    if self.subsample > 0:
-                        chunk = chunk[subsample_mask(chunk, counts, self.subsample, rng)]
                     centers, contexts = skipgram_pairs(chunk, self.window, rng)
                 # macro-batches: steps_per_call optimizer steps per dispatch.
                 # Native path: the C++ PairPrefetcher shuffles and slices in
@@ -434,6 +459,41 @@ class Word2VecTrainer(Trainer):
             PackedTableState(table=out_t, slots=state.out_table.slots),
         ), loss, jnp.int32(0)
 
+    def _substep_grouped(self, state: W2VState, centers, ctxs, rng, lr):
+        """Center-major single-kernel hogwild substep (fused_sgns_grouped)."""
+        from swiftsnails_tpu.ops import rowdma
+        from swiftsnails_tpu.ops.fused_sgns import fused_sgns_grouped_step
+
+        n = centers.shape[0]
+        # largest divisor of n not exceeding centers_per_block (static under
+        # jit), so small test batches work unchanged
+        pc = min(self.centers_per_block, n)
+        while n % pc:
+            pc -= 1
+        nb = n // pc
+        pn = self.pool_size
+        pools = alias_sample(self.neg_alias, rng, (nb, pn))
+        ctx_rows = jnp.where(
+            ctxs >= 0, self._rows(jnp.maximum(ctxs, 0)), -1
+        )  # hash real ids only; pads stay -1
+        in_t, out_t, loss = fused_sgns_grouped_step(
+            state.in_table.table,
+            state.out_table.table,
+            self._rows(centers),
+            ctx_rows,
+            self._rows(pools.reshape(-1)),
+            lr=self.lr,
+            lam=self.negatives / pn,
+            window=self.window,
+            centers_per_block=pc,
+            pool_size=pn,
+            interpret=not rowdma.on_tpu(),
+        )
+        return W2VState(
+            PackedTableState(table=in_t, slots=state.in_table.slots),
+            PackedTableState(table=out_t, slots=state.out_table.slots),
+        ), loss, jnp.int32(0)
+
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
         """Packed tables with reference-faithful per-pair K negatives."""
         b = centers.shape[0]
@@ -468,7 +528,9 @@ class Word2VecTrainer(Trainer):
         n = centers.shape[0]
         t = max(n // self.batch_size, 1)
         b = n // t
-        if self.fused:
+        if self.fused and self.grouped:
+            substep = self._substep_grouped
+        elif self.fused:
             substep = self._substep_fused
         elif self.packed:
             substep = (
@@ -504,7 +566,9 @@ class Word2VecTrainer(Trainer):
 
         keys = jax.random.split(rng, t)
         state, (losses, drops) = jax.lax.scan(
-            body, state, (centers.reshape(t, b), contexts.reshape(t, b), keys)
+            body, state,
+            (centers.reshape(t, b),
+             contexts.reshape((t, b) + contexts.shape[1:]), keys),
         )
         return state, metrics_of(losses.mean(), drops.sum())
 

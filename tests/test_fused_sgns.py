@@ -119,3 +119,92 @@ def test_fused_trains_toy_corpus():
         if len(losses) >= 40:
             break
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+# ---------------------------------------------------------------- grouped ---
+
+
+def reference_grouped(in_t, out_t, centers, ctxs, pool_rows, lr, lam, window,
+                      pc, pn):
+    """Sequential reference for the center-major kernel: same double-buffer
+    staleness window as reference_fused; per block, reads see writes of
+    blocks <= b-2, pool shared center-wide, pads skipped."""
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_grouped_step  # noqa
+
+    in_t = in_t.copy()
+    out_t = out_t.copy()
+    n, cw = ctxs.shape
+    nblocks = n // pc
+    inv_b = 1.0 / (n * (window + 1))
+    d = in_t.shape[1] * in_t.shape[2]
+    shape = in_t.shape[1:]
+    total_loss = 0.0
+    snap_in, snap_out = in_t.copy(), out_t.copy()
+    for blk in range(nblocks):
+        cr = centers[blk * pc : (blk + 1) * pc]
+        cx = ctxs[blk * pc : (blk + 1) * pc]  # [pc, cw], -1 pads
+        qr = pool_rows[blk * pn : (blk + 1) * pn]
+        V = snap_in[cr].reshape(pc, d).astype(np.float32)
+        U = np.zeros((cw, pc, d), np.float32)
+        mask = np.zeros((cw, pc), np.float32)
+        for p in range(pc):
+            for c in range(cw):
+                if cx[p, c] >= 0:
+                    U[c, p] = snap_out[cx[p, c]].reshape(d)
+                    mask[c, p] = 1.0
+        Q = snap_out[qr].reshape(pn, d).astype(np.float32)
+        snap_in, snap_out = in_t.copy(), out_t.copy()
+        pos = (U * V[None]).sum(-1)  # [cw, pc]
+        n_real = mask.sum(0)  # [pc]
+        neg = V @ Q.T  # [pc, pn]
+        g_pos = (_sigmoid(pos) - 1.0) * inv_b * mask
+        g_neg = lam * inv_b * _sigmoid(neg) * n_real[:, None]
+        dV = (g_pos[:, :, None] * U).sum(0) + g_neg @ Q
+        dU = g_pos[:, :, None] * V[None]
+        dQ = g_neg.T @ V
+        for p in range(pc):
+            in_t[cr[p]] = (V[p] - lr * dV[p]).reshape(shape)
+        # U writes in compacted (c-major) order, later write wins
+        for c in range(cw):
+            for p in range(pc):
+                if cx[p, c] >= 0:
+                    out_t[cx[p, c]] = (U[c, p] - lr * dU[c, p]).reshape(shape)
+        for q in range(pn):
+            out_t[qr[q]] = (Q[q] - lr * dQ[q]).reshape(shape)
+        total_loss += -(
+            (np.log(_sigmoid(pos)) * mask).sum()
+            + lam * (np.log(_sigmoid(-neg)) * n_real[:, None]).sum()
+        ) * inv_b
+    return in_t, out_t, total_loss
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_grouped_matches_sequential_reference(seed):
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_grouped_step
+
+    rng = np.random.default_rng(seed)
+    C, S, L = 64, 2, 128
+    N, PC, PN, W = 32, 8, 4, 3
+    CW = 2 * W
+    in_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    out_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    centers = rng.integers(0, C, N).astype(np.int32)
+    ctxs = rng.integers(0, C, (N, CW)).astype(np.int32)
+    # random pads (including fully-padded centers) + duplicates
+    ctxs[rng.random((N, CW)) < 0.4] = -1
+    ctxs[3] = -1
+    pool_rows = rng.integers(0, C, (N // PC) * PN).astype(np.int32)
+    lr, lam = 0.05, 0.625
+
+    want_in, want_out, want_loss = reference_grouped(
+        in_t, out_t, centers, ctxs, pool_rows, lr, lam, W, PC, PN
+    )
+    got_in, got_out, got_loss = fused_sgns_grouped_step(
+        jnp.asarray(in_t), jnp.asarray(out_t), jnp.asarray(centers),
+        jnp.asarray(ctxs), jnp.asarray(pool_rows),
+        lr=lr, lam=lam, window=W, centers_per_block=PC, pool_size=PN,
+        interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_in), want_in, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_out), want_out, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)

@@ -23,6 +23,9 @@ PATHS = {
     "packed_pool": {"packed": "1", "neg_mode": "pool"},
     # hogwild: within-block duplicate-row races lose some updates
     "fused": {"packed": "1", "neg_mode": "pool", "fused": "1"},
+    # center-major kernel (word2vec.c loop order), same hogwild semantics
+    "fused_grouped": {"packed": "1", "neg_mode": "pool", "fused": "1",
+                      "grouped": "1"},
 }
 
 
