@@ -149,26 +149,6 @@ def skipgram_windows(
     return ids.astype(np.int32, copy=True), ctxs
 
 
-def window_batch_stream(
-    centers: np.ndarray,
-    ctxs: np.ndarray,
-    batch_size: int,
-    rng: np.random.Generator,
-    shuffle: bool = True,
-):
-    """Yield {'centers' [B], 'contexts' [B, CW]} batches (drop remainder).
-
-    Shuffles CENTERS (whole windows move together) — pair order inside a
-    window stays sequential, word2vec.c-style.
-    """
-    n = len(centers)
-    order = rng.permutation(n) if shuffle else np.arange(n)
-    end = (n // batch_size) * batch_size
-    for start in range(0, end, batch_size):
-        sel = order[start : start + batch_size]
-        yield {"centers": centers[sel], "contexts": ctxs[sel]}
-
-
 def batch_stream(
     centers: np.ndarray,
     contexts: np.ndarray,
@@ -177,7 +157,12 @@ def batch_stream(
     shuffle: bool = True,
     drop_remainder: bool = True,
 ):
-    """Yield {'centers', 'contexts'} batches of exactly ``batch_size``."""
+    """Yield {'centers', 'contexts'} batches of exactly ``batch_size``.
+
+    ``contexts`` may be 2-D (the window schema [N, 2w] from
+    :func:`skipgram_windows`): rows shuffle whole — windows move together,
+    pair order inside a window stays sequential, word2vec.c-style.
+    """
     n = len(centers)
     order = rng.permutation(n) if shuffle else np.arange(n)
     end = (n // batch_size) * batch_size if drop_remainder else n

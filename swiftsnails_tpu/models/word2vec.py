@@ -36,7 +36,6 @@ from swiftsnails_tpu.data.sampler import (
     skipgram_pairs,
     skipgram_windows,
     subsample_mask,
-    window_batch_stream,
 )
 from swiftsnails_tpu.data.text import encode_corpus
 from swiftsnails_tpu.data.vocab import Vocab
@@ -325,7 +324,7 @@ class Word2VecTrainer(Trainer):
                     macro = self.batch_size * self.steps_per_call
                     n_batches = max(len(g_c) // macro, 1)
                     for bi, b in enumerate(
-                        window_batch_stream(g_c, g_x, macro, rng)
+                        batch_stream(g_c, g_x, macro, rng)
                     ):
                         p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
                         yield {**b, "progress": np.float32(min(p, 1.0))}

@@ -140,9 +140,30 @@ def _kernel(in_rows_ref, pos_rows_ref, pool_rows_ref,
             wait_all(i - 1, (i - 1) % 2, "write")
 
 
+_ROW_MASK = (1 << 30) - 1  # c_rows: row id | is-last-occurrence << 30
+_SLOT_MASK = (1 << 20) - 1  # ctx_slot: buffer slot | is-last-occurrence << 20
+
+
+def _last_occurrence(rows: jax.Array, valid: jax.Array) -> jax.Array:
+    """Per block-row: True where element k is the LAST valid occurrence of
+    its value (``rows`` [NB, K] i32, ``valid`` [NB, K] bool)."""
+    nb, k = rows.shape
+    big = jnp.int32(2**31 - 1)
+    keyed = jnp.where(valid, rows, big)
+    # stable sort groups equal rows in ascending original index, so the last
+    # element of each run is the last occurrence
+    order = jnp.argsort(keyed, axis=1, stable=True)
+    srow = jnp.take_along_axis(keyed, order, axis=1)
+    last_sorted = jnp.concatenate(
+        [srow[:, :-1] != srow[:, 1:], jnp.ones((nb, 1), bool)], axis=1
+    ) & (srow != big)
+    out = jnp.zeros((nb, k), bool)
+    return out.at[jnp.arange(nb)[:, None], order].set(last_sorted)
+
+
 def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
-                    pool_rows_ref, mask_in, in_t_in, out_t_in,
-                    in_table, out_table, loss_ref,
+                    nwc_ref, nwu_ref, pool_rows_ref, mask_in, in_t_in,
+                    out_t_in, in_table, out_table, loss_ref,
                     v_buf, u_buf, p_buf, read_sems, write_sems,
                     *, lr, lam, inv_b, pc, cw, pool):
     """Center-major fused SGNS substep (see fused_sgns_grouped_step).
@@ -151,7 +172,10 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
     the measured bound (throughput is flat in row size AND row locality).
     Grouping by center loads each center row once for its whole window and
     skips padded context slots entirely (host-compacted copy list, dynamic
-    wait counts), cutting copies/pair to ~2.5.
+    wait counts), cutting copies/pair to ~2.5. Writeback skips every
+    non-LAST duplicate-row slot (flag bits packed by the wrapper): under
+    last-write-wins those writes can never survive, so the final table is
+    bit-identical with ~dup-fraction fewer write copies.
     """
     del in_t_in, out_t_in
     PC, CW, PN = pc, cw, pool
@@ -160,20 +184,33 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
     cap = PC * CW
 
     def dmas(b, slot, table_dir):
-        sems = read_sems if table_dir == "read" else write_sems
+        read = table_dir == "read"
+        sems = read_sems if read else write_sems
 
         def mk(buf_at, table, row):
             pair = (table.at[row], buf_at)
-            src, dst = pair if table_dir == "read" else pair[::-1]
+            src, dst = pair if read else pair[::-1]
             return pltpu.make_async_copy(src, dst, sems.at[slot])
 
         def v_dma(p, _):
-            mk(v_buf.at[slot, p], in_table, c_rows_ref[b * PC + p]).start()
+            v = c_rows_ref[b * PC + p]
+            if read:
+                mk(v_buf.at[slot, p], in_table, v & _ROW_MASK).start()
+            else:
+                @pl.when((v >> 30) != 0)
+                def _():
+                    mk(v_buf.at[slot, p], in_table, v & _ROW_MASK).start()
             return 0
 
         def u_dma(k, _):
-            mk(u_buf.at[slot, ctx_slot_ref[b * cap + k]], out_table,
-               ctx_rows_ref[b * cap + k]).start()
+            s = ctx_slot_ref[b * cap + k]
+            row = ctx_rows_ref[b * cap + k]
+            if read:
+                mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
+            else:
+                @pl.when((s >> 20) != 0)
+                def _():
+                    mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
             return 0
 
         def p_dma(q, _):
@@ -185,7 +222,13 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
         jax.lax.fori_loop(0, PN, p_dma, 0)
 
     def wait_all(b, slot, table_dir):
-        sems = read_sems if table_dir == "read" else write_sems
+        read = table_dir == "read"
+        sems = read_sems if read else write_sems
+        count = (
+            PC + PN + nctx_ref[b]
+            if read
+            else nwc_ref[b] + PN + nwu_ref[b]
+        )
 
         def w(j, _):
             pltpu.make_async_copy(
@@ -193,7 +236,7 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
             ).wait()
             return 0
 
-        jax.lax.fori_loop(0, PC + PN + nctx_ref[b], w, 0)
+        jax.lax.fori_loop(0, count, w, 0)
 
     @pl.when(i == 0)
     def _():
@@ -301,6 +344,11 @@ def fused_sgns_grouped_step(
     cap = pc * cw
     inv_b = 1.0 / (n * (window + 1))
 
+    if cap > _SLOT_MASK:
+        raise ValueError(f"centers_per_block*2*window {cap} exceeds slot bits")
+    if in_table.shape[0] > _ROW_MASK or out_table.shape[0] > _ROW_MASK:
+        raise ValueError("table capacity exceeds 2^30 (row-id flag bit)")
+
     # [CW, PC] orientation throughout (PC = lanes): flat slot k = c*PC + p
     flat = (
         ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
@@ -313,11 +361,24 @@ def fused_sgns_grouped_step(
     nctx = valid.sum(axis=1).astype(jnp.int32)
     mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
 
+    # last-occurrence flags: under last-write-wins only the LAST write of a
+    # duplicated row within a block survives, so all others are skipped in
+    # the writeback (bit-identical result, fewer copies)
+    valid_k = jnp.arange(cap)[None, :] < nctx[:, None]
+    u_last = _last_occurrence(ctx_rows, valid_k)
+    nwrite_u = (u_last & valid_k).sum(axis=1).astype(jnp.int32)
+    ctx_slot = (order | jnp.where(u_last, 1 << 20, 0)).astype(jnp.int32)
+
+    c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
+    c_last = _last_occurrence(c_blocks, jnp.ones_like(c_blocks, bool))
+    nwrite_c = c_last.sum(axis=1).astype(jnp.int32)
+    c_packed = (c_blocks | jnp.where(c_last, 1 << 30, 0)).reshape(-1)
+
     kern = functools.partial(
         _grouped_kernel, lr=lr, lam=lam, inv_b=inv_b, pc=pc, cw=cw, pool=pn
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5,
+        num_scalar_prefetch=7,
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((1, cw, pc), lambda i, *_: (i, 0, 0)),  # mask
@@ -345,14 +406,16 @@ def fused_sgns_grouped_step(
             jax.ShapeDtypeStruct(out_table.shape, out_table.dtype),
             jax.ShapeDtypeStruct((nblocks, 8, 128), jnp.float32),
         ),
-        input_output_aliases={6: 0, 7: 1},
+        input_output_aliases={8: 0, 9: 1},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(
-        centers.astype(jnp.int32),
+        c_packed,
         ctx_rows.reshape(-1),
-        order.reshape(-1).astype(jnp.int32),
+        ctx_slot.reshape(-1),
         nctx,
+        nwrite_c,
+        nwrite_u,
         pool_rows.astype(jnp.int32),
         mask,
         in_table,
