@@ -19,15 +19,20 @@ run_mode() {
   echo "=== $name build ==="
   g++ -O1 -g -std=c++17 -shared -fPIC -pthread $flags \
       -o "$OUT_DIR/libsnails_$name.so" "$SRC"
-  echo "=== $name: pytest tests/test_native.py ==="
+  echo "=== $name: pytest tests/test_native.py tests/test_streaming.py ==="
   # Preload the sanitizer runtime into python and point the bindings at the
-  # instrumented build.
+  # instrumented build. test_streaming drives the chunked readers (token +
+  # CTR streams, byte-span splits) through the instrumented library.
   local so="$OUT_DIR/libsnails_$name.so"
+  # -k: the sanitizer surface is the NATIVE code — jax-training and
+  # subprocess tests (trainer integration, constant-RSS) hang or crawl
+  # under a sanitizer-preloaded jax and exercise no new native paths.
   SSN_NATIVE_SO="$so" \
   LD_PRELOAD="$(g++ -print-file-name=lib${name}.so)" \
   ASAN_OPTIONS=detect_leaks=0 \
   JAX_PLATFORMS=cpu \
-  python -m pytest tests/test_native.py -q
+  python -m pytest tests/test_native.py tests/test_streaming.py -q \
+      -k "not stream_mode and not ctr_trainer and not constant_rss and not trainer_batches"
 }
 
 case "$MODE" in
