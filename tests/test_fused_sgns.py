@@ -208,3 +208,41 @@ def test_grouped_matches_sequential_reference(seed):
     np.testing.assert_allclose(np.asarray(got_in), want_in, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(got_out), want_out, rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
+
+
+def test_grouped_trainer_hash_keys_and_stream(tmp_path):
+    """Grouped path with hash_keys: 1 (pads must stay -1 through hashing)
+    and stream: 1 ingestion feeding window batches, end to end on CPU
+    interpret."""
+    import os
+
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(48)]
+    path = tmp_path / "c.txt"
+    with open(path, "w") as f:
+        for _ in range(400):
+            f.write(" ".join(words[i] for i in rng.integers(0, 48, 12)) + "\n")
+    cfg = Config({
+        "data": str(path), "dim": "8", "window": "2", "negatives": "2",
+        "learning_rate": "0.1", "batch_size": "64", "subsample": "0",
+        "num_iters": "1", "min_count": "1", "packed": "1",
+        "neg_mode": "pool", "pool_size": "8", "pool_block": "32",
+        "fused": "1", "grouped": "1", "hash_keys": "1", "capacity": "128",
+        "stream": "1", "chunk_tokens": "1500", "use_native": "0",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None)
+    assert tr.grouped and tr.hash_keys and tr.stream
+    state = tr.init_state()
+    step = jax.jit(tr.train_step, donate_argnums=(0,))
+    n = 0
+    for batch in tr.batches():
+        assert batch["contexts"].ndim == 2  # window schema
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(jax.random.PRNGKey(0), n))
+        n += 1
+        if n >= 4:
+            break
+    assert n >= 2 and np.isfinite(float(m["loss"]))
