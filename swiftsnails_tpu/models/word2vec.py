@@ -21,6 +21,7 @@ Config keys: ``dim``, ``window``, ``negatives``, ``learning_rate``,
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, Iterator, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -148,6 +149,17 @@ class Word2VecTrainer(Trainer):
         self.grouped = cfg.get_bool("grouped", False) and self.fused
         if cfg.get_bool("grouped", False) and not cfg.get_bool("fused", False):
             raise ValueError("grouped: 1 requires fused: 1")
+        # resident: 1 -> grouped kernel + VMEM-resident head rows: rows
+        # < hot_rows of both tables live on-chip for the whole substep, read
+        # via one-hot MXU expansion and updated with exact merged gradient
+        # sums (deterministic for hot rows; see ops/fused_sgns.py). Wins when
+        # row ids are frequency-ranked (Vocab order) so the zipf head stays
+        # resident; with hash_keys the hot set is arbitrary (correct, less
+        # win).
+        self.resident = cfg.get_bool("resident", False) and self.grouped
+        if cfg.get_bool("resident", False) and not cfg.get_bool("grouped", False):
+            raise ValueError("resident: 1 requires grouped: 1")
+        self.hot_rows = cfg.get_int("hot_rows", 1024)
         # centers per kernel block; per-substep center count is batch_size
         self.centers_per_block = cfg.get_int("centers_per_block", 256)
         if self.fused and self.lr_decay:
@@ -459,9 +471,14 @@ class Word2VecTrainer(Trainer):
         ), loss, jnp.int32(0)
 
     def _substep_grouped(self, state: W2VState, centers, ctxs, rng, lr):
-        """Center-major single-kernel hogwild substep (fused_sgns_grouped)."""
+        """Center-major single-kernel hogwild substep (fused_sgns_grouped);
+        with ``resident: 1`` the head rows stay VMEM-resident
+        (fused_sgns_resident_step)."""
         from swiftsnails_tpu.ops import rowdma
-        from swiftsnails_tpu.ops.fused_sgns import fused_sgns_grouped_step
+        from swiftsnails_tpu.ops.fused_sgns import (
+            fused_sgns_grouped_step,
+            fused_sgns_resident_step,
+        )
 
         n = centers.shape[0]
         # largest divisor of n not exceeding centers_per_block (static under
@@ -475,7 +492,15 @@ class Word2VecTrainer(Trainer):
         ctx_rows = jnp.where(
             ctxs >= 0, self._rows(jnp.maximum(ctxs, 0)), -1
         )  # hash real ids only; pads stay -1
-        in_t, out_t, loss = fused_sgns_grouped_step(
+        # resident needs >= 8 hot rows after clipping to capacity
+        hot_n = min(self.hot_rows, self.capacity)
+        if self.resident and hot_n >= 8:
+            step_fn = functools.partial(
+                fused_sgns_resident_step, hot_rows=hot_n
+            )
+        else:
+            step_fn = fused_sgns_grouped_step
+        in_t, out_t, loss = step_fn(
             state.in_table.table,
             state.out_table.table,
             self._rows(centers),

@@ -210,6 +210,156 @@ def test_grouped_matches_sequential_reference(seed):
     np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
 
 
+# --------------------------------------------------------------- resident ---
+
+
+def reference_resident(in_t, out_t, centers, ctxs, pool_rows, lr, lam, window,
+                       pc, pn, hot_n):
+    """Sequential reference for the resident kernel: rows < hot_n live in a
+    resident copy — reads always current (writes <= b-1), duplicate slots
+    within a block SUM their gradients (merged, deterministic). Cold rows
+    keep the grouped kernel's semantics: reads see writes <= b-2,
+    last-write-wins in V, U (c-major), pool order."""
+    in_t = in_t.copy()
+    out_t = out_t.copy()
+    hi, ho = in_t[:hot_n].copy(), out_t[:hot_n].copy()
+    n, cw = ctxs.shape
+    nblocks = n // pc
+    inv_b = 1.0 / (n * (window + 1))
+    d = in_t.shape[1] * in_t.shape[2]
+    shape = in_t.shape[1:]
+    total_loss = 0.0
+    snap_in, snap_out = in_t.copy(), out_t.copy()
+    for blk in range(nblocks):
+        cr = centers[blk * pc : (blk + 1) * pc]
+        cx = ctxs[blk * pc : (blk + 1) * pc]  # [pc, cw], -1 pads
+        qr = pool_rows[blk * pn : (blk + 1) * pn]
+        V = np.stack([
+            hi[r].reshape(d) if r < hot_n else snap_in[r].reshape(d)
+            for r in cr
+        ]).astype(np.float32)
+        U = np.zeros((cw, pc, d), np.float32)
+        mask = np.zeros((cw, pc), np.float32)
+        for p in range(pc):
+            for c in range(cw):
+                r = cx[p, c]
+                if r >= 0:
+                    U[c, p] = (ho[r] if r < hot_n else snap_out[r]).reshape(d)
+                    mask[c, p] = 1.0
+        Q = np.stack([
+            (ho[r] if r < hot_n else snap_out[r]).reshape(d) for r in qr
+        ]).astype(np.float32)
+        snap_in, snap_out = in_t.copy(), out_t.copy()
+        pos = (U * V[None]).sum(-1)
+        n_real = mask.sum(0)
+        neg = V @ Q.T
+        g_pos = (_sigmoid(pos) - 1.0) * inv_b * mask
+        g_neg = lam * inv_b * _sigmoid(neg) * n_real[:, None]
+        dV = (g_pos[:, :, None] * U).sum(0) + g_neg @ Q
+        dU = g_pos[:, :, None] * V[None]
+        dQ = g_neg.T @ V
+        # hot: exact merged accumulation, one application per row
+        dv_sum = np.zeros((hot_n, d), np.float32)
+        du_sum = np.zeros((hot_n, d), np.float32)
+        for p in range(pc):
+            if cr[p] < hot_n:
+                dv_sum[cr[p]] += dV[p]
+            else:
+                in_t[cr[p]] = (V[p] - lr * dV[p]).reshape(shape)
+        for c in range(cw):  # cold U writes in c-major order, later wins
+            for p in range(pc):
+                r = cx[p, c]
+                if r >= 0:
+                    if r < hot_n:
+                        du_sum[r] += dU[c, p]
+                    else:
+                        out_t[r] = (U[c, p] - lr * dU[c, p]).reshape(shape)
+        for q in range(pn):
+            if qr[q] < hot_n:
+                du_sum[qr[q]] += dQ[q]
+            else:
+                out_t[qr[q]] = (Q[q] - lr * dQ[q]).reshape(shape)
+        hi -= (lr * dv_sum).reshape((hot_n,) + shape)
+        ho -= (lr * du_sum).reshape((hot_n,) + shape)
+        total_loss += -(
+            (np.log(_sigmoid(pos)) * mask).sum()
+            + lam * (np.log(_sigmoid(-neg)) * n_real[:, None]).sum()
+        ) * inv_b
+    in_t[:hot_n] = hi
+    out_t[:hot_n] = ho
+    return in_t, out_t, total_loss
+
+
+@pytest.mark.parametrize("seed,hot_rows", [(0, 32), (1, 32), (0, 64)])
+def test_resident_matches_sequential_reference(seed, hot_rows):
+    """hot_rows=32: mixed hot/cold traffic; hot_rows=64 (= capacity): fully
+    deterministic merged semantics."""
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_resident_step
+
+    rng = np.random.default_rng(seed)
+    C, S, L = 64, 2, 128
+    N, PC, PN, W = 32, 8, 4, 3
+    CW = 2 * W
+    in_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    out_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    centers = rng.integers(0, C, N).astype(np.int32)
+    ctxs = rng.integers(0, C, (N, CW)).astype(np.int32)
+    ctxs[rng.random((N, CW)) < 0.4] = -1
+    ctxs[3] = -1
+    pool_rows = rng.integers(0, C, (N // PC) * PN).astype(np.int32)
+    lr, lam = 0.05, 0.625
+
+    want_in, want_out, want_loss = reference_resident(
+        in_t, out_t, centers, ctxs, pool_rows, lr, lam, W, PC, PN, hot_rows
+    )
+    got_in, got_out, got_loss = fused_sgns_resident_step(
+        jnp.asarray(in_t), jnp.asarray(out_t), jnp.asarray(centers),
+        jnp.asarray(ctxs), jnp.asarray(pool_rows),
+        lr=lr, lam=lam, window=W, centers_per_block=PC, pool_size=PN,
+        hot_rows=hot_rows, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_in), want_in, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_out), want_out, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
+
+
+def test_resident_trainer_trains_toy_corpus():
+    """resident: 1 end to end through the trainer (mixed hot/cold rows:
+    hot_rows below vocab size), CPU interpret."""
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    vocab_size = 48
+    counts = np.sort(rng.integers(1, 50, vocab_size))[::-1].astype(np.int64)
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)], counts)
+    base = np.repeat(np.arange(12), 50) % vocab_size
+    corpus = ((base + rng.integers(0, 2, base.size)) % vocab_size).astype(np.int32)
+    cfg = Config({
+        "dim": "16", "window": "2", "negatives": "2", "learning_rate": "0.1",
+        "batch_size": "64", "subsample": "0", "num_iters": "20",
+        "pool_size": "8", "pool_block": "16", "packed": "1", "fused": "1",
+        "grouped": "1", "resident": "1", "hot_rows": "24",
+        "use_native": "0",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None, corpus_ids=corpus, vocab=vocab)
+    assert tr.resident and tr.grouped
+    state = tr.init_state()
+    step = jax.jit(tr.train_step)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i, batch in enumerate(tr.batches()):
+        if batch["centers"].shape[0] % 64:
+            continue
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(key, i))
+        losses.append(float(m["loss"]))
+        if len(losses) >= 40:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
 def test_grouped_trainer_hash_keys_and_stream(tmp_path):
     """Grouped path with hash_keys: 1 (pads must stay -1 through hashing)
     and stream: 1 ingestion feeding window batches, end to end on CPU

@@ -26,6 +26,10 @@ PATHS = {
     # center-major kernel (word2vec.c loop order), same hogwild semantics
     "fused_grouped": {"packed": "1", "neg_mode": "pool", "fused": "1",
                       "grouped": "1"},
+    # VMEM-resident head rows: hot rows get exact merged updates (at probe
+    # scale the whole table is hot -> fully deterministic)
+    "fused_resident": {"packed": "1", "neg_mode": "pool", "fused": "1",
+                       "grouped": "1", "resident": "1"},
 }
 
 
