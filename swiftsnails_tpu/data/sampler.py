@@ -169,3 +169,37 @@ def batch_stream(
     for start in range(0, end, batch_size):
         sel = order[start : start + batch_size]
         yield {"centers": centers[sel], "contexts": contexts[sel]}
+
+
+def batch_stream_blocks(
+    centers: np.ndarray,
+    contexts: np.ndarray,
+    batch_size: int,
+    rng: np.random.Generator,
+    block: int,
+):
+    """:func:`batch_stream` shuffling BLOCKS of ``block`` consecutive
+    windows instead of individual windows.
+
+    Within a block the corpus order is preserved, so a kernel block of
+    ``block`` centers spans ~``block`` consecutive tokens and touches only
+    ~``block`` DISTINCT context rows (adjacent windows overlap) — the
+    locality the dedup kernel's per-block unique-row copy list turns into
+    ~5x fewer read DMAs. word2vec.c trains fully sequentially; shuffling at
+    block granularity keeps SGD mixing across blocks/epochs while restoring
+    that local structure.
+    """
+    if batch_size % block:
+        # batches must be EXACTLY batch_size (train_step reshapes by it):
+        # shrink to the largest divisor of batch_size not exceeding block
+        block = next(d for d in range(min(block, batch_size), 0, -1)
+                     if batch_size % d == 0)
+    n = (len(centers) // block) * block
+    nblocks = n // block
+    order = rng.permutation(nblocks)
+    blocks_per_batch = batch_size // block
+    end = (nblocks // blocks_per_batch) * blocks_per_batch
+    for start in range(0, end, blocks_per_batch):
+        sel = (order[start : start + blocks_per_batch, None] * block
+               + np.arange(block)[None, :]).reshape(-1)
+        yield {"centers": centers[sel], "contexts": contexts[sel]}

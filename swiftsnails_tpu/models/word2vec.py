@@ -22,6 +22,7 @@ Config keys: ``dim``, ``window``, ``negatives``, ``learning_rate``,
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Dict, Iterator, NamedTuple, Optional, Tuple
 
 import numpy as np
@@ -134,13 +135,16 @@ class Word2VecTrainer(Trainer):
             raise ValueError("neg_mode: pool requires packed tables (packed: 1)")
         self.pool_size = cfg.get_int("pool_size", 64)
         self.pool_block = cfg.get_int("pool_block", 512)
-        # fused: 1 -> the single-kernel hogwild substep (ops/fused_sgns.py;
-        # reference async-SGD semantics). Requires packed+pool, single device.
+        # fused: 1 -> single device: the single-kernel hogwild substep
+        # (ops/fused_sgns.py; reference async-SGD semantics). Under a mesh
+        # the grouped schema runs the collective grouped plane instead
+        # (_substep_grouped_mesh): same center-major traffic cut, shard-local
+        # row-DMA kernels inside the shard_map pull/push collectives.
+        # Requires packed+pool.
         self.fused = (
             cfg.get_bool("fused", False)
             and self.packed
             and self.neg_mode == "pool"
-            and mesh is None
         )
         # grouped: 1 -> center-major fused kernel (word2vec.c loop order: one
         # center-row DMA per window instead of per pair; the per-row copy
@@ -160,13 +164,19 @@ class Word2VecTrainer(Trainer):
         if cfg.get_bool("resident", False) and not cfg.get_bool("grouped", False):
             raise ValueError("resident: 1 requires grouped: 1")
         self.hot_rows = cfg.get_int("hot_rows", 1024)
+        # dedup: 1 -> per-block context-read dedup (fused_sgns_dedup_step)
+        # over BLOCK-ORDERED batches: one DMA per distinct context row per
+        # block instead of per slot. Takes precedence over resident (it
+        # targets the same duplicate traffic, without burning VMEM on a
+        # global head). Requires grouped: 1.
+        self.dedup = cfg.get_bool("dedup", False) and self.grouped
+        if cfg.get_bool("dedup", False) and not cfg.get_bool("grouped", False):
+            raise ValueError("dedup: 1 requires grouped: 1")
+        self.u_cap = cfg.get_int("u_cap", 512)
         # centers per kernel block; per-substep center count is batch_size
         self.centers_per_block = cfg.get_int("centers_per_block", 256)
-        if self.fused and self.lr_decay:
-            # the fused kernel bakes lr in at Mosaic compile time
-            # (ops/fused_sgns.py static_argnames); a traced decayed lr
-            # cannot reach it
-            raise ValueError("lr_decay is not supported with fused: 1")
+        # lr reaches the fused kernels as a scalar-prefetch operand (SMEM),
+        # so lr_decay works on every path without recompiling per lr value
         # scan this many optimizer substeps per dispatch (amortizes host->TPU
         # dispatch latency). NOTE: TrainLoop steps/checkpoints count
         # dispatches, so substeps scale throughput, not the step counter.
@@ -179,12 +189,17 @@ class Word2VecTrainer(Trainer):
         self.push_mode = cfg.get_str("push_mode", "gather")
         if self.push_mode not in ("gather", "bucketed"):
             raise ValueError(f"push_mode must be gather|bucketed, got {self.push_mode}")
-        if self.push_mode == "bucketed" and (not self.packed or self.fused):
+        if self.push_mode == "bucketed" and (
+            not self.packed or (self.fused and mesh is None)
+        ):
             # only the packed collective path routes through _ppush; dense
-            # uses the pjit store.push and fused bypasses push entirely —
-            # accepting the key there would silently run the exact push
-            # while reporting push_dropped: 0
-            raise ValueError("push_mode: bucketed requires packed: 1 without fused: 1")
+            # uses the pjit store.push and single-device fused bypasses push
+            # entirely — accepting the key there would silently run the
+            # exact push while reporting push_dropped: 0. Under a mesh the
+            # fused-grouped plane pushes through _ppush, so bucketed works.
+            raise ValueError(
+                "push_mode: bucketed requires packed: 1, and fused: 1 only "
+                "with a mesh (single-device fused has no push collective)")
         self.bucket_slack = cfg.get_float("bucket_slack", 2.0)
 
         # stream: 1 = bounded-memory ingestion — the corpus is never
@@ -242,6 +257,26 @@ class Word2VecTrainer(Trainer):
             )
         self.access = SgdAccess()
         self.neg_alias = build_unigram_alias(vocab.counts)
+        if self.resident:
+            # surface the kernel's rounding so operators see what actually
+            # runs: hot_rows clips to capacity and rounds to the one-hot
+            # chunk size; < 8 rows falls back to the grouped kernel entirely
+            from swiftsnails_tpu.ops.fused_sgns import effective_hot_rows
+
+            eff, _ = effective_hot_rows(self.hot_rows, self.capacity)
+            log = logging.getLogger(__name__)
+            if eff < 8:
+                log.warning(
+                    "resident: 1 with hot_rows=%d (capacity %d) leaves <8 "
+                    "resident rows; falling back to the grouped kernel",
+                    self.hot_rows, self.capacity,
+                )
+            elif eff != self.hot_rows:
+                log.info(
+                    "resident hot_rows=%d rounds to %d effective resident "
+                    "rows (capacity clip + one-hot chunk size)",
+                    self.hot_rows, eff,
+                )
 
     # -- state -------------------------------------------------------------
 
@@ -331,13 +366,22 @@ class Word2VecTrainer(Trainer):
                 if self.grouped:
                     # center-major window schema for the grouped kernel; one
                     # batch row = one corpus position (word), whole windows
-                    # shuffle together (word2vec.c pair order within)
+                    # shuffle together (word2vec.c pair order within). The
+                    # dedup kernel shuffles at BLOCK granularity instead, so
+                    # each kernel block keeps corpus-local (overlapping)
+                    # windows — the locality its unique-row copy list needs.
+                    from swiftsnails_tpu.data.sampler import batch_stream_blocks
+
                     g_c, g_x = skipgram_windows(chunk, self.window, rng)
                     macro = self.batch_size * self.steps_per_call
                     n_batches = max(len(g_c) // macro, 1)
-                    for bi, b in enumerate(
-                        batch_stream(g_c, g_x, macro, rng)
-                    ):
+                    stream = (
+                        batch_stream_blocks(g_c, g_x, macro, rng,
+                                            block=self.centers_per_block)
+                        if self.dedup
+                        else batch_stream(g_c, g_x, macro, rng)
+                    )
+                    for bi, b in enumerate(stream):
                         p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
                         yield {**b, "progress": np.float32(min(p, 1.0))}
                     continue
@@ -459,7 +503,7 @@ class Word2VecTrainer(Trainer):
             self._rows(centers),
             self._rows(contexts),
             self._rows(pools.reshape(-1)),
-            lr=self.lr,
+            lr=lr,
             lam=self.negatives / pn,
             pairs_per_block=pb,
             pool_size=pn,
@@ -476,6 +520,7 @@ class Word2VecTrainer(Trainer):
         (fused_sgns_resident_step)."""
         from swiftsnails_tpu.ops import rowdma
         from swiftsnails_tpu.ops.fused_sgns import (
+            fused_sgns_dedup_step,
             fused_sgns_grouped_step,
             fused_sgns_resident_step,
         )
@@ -494,7 +539,9 @@ class Word2VecTrainer(Trainer):
         )  # hash real ids only; pads stay -1
         # resident needs >= 8 hot rows after clipping to capacity
         hot_n = min(self.hot_rows, self.capacity)
-        if self.resident and hot_n >= 8:
+        if self.dedup:
+            step_fn = functools.partial(fused_sgns_dedup_step, u_cap=self.u_cap)
+        elif self.resident and hot_n >= 8:
             step_fn = functools.partial(
                 fused_sgns_resident_step, hot_rows=hot_n
             )
@@ -506,7 +553,7 @@ class Word2VecTrainer(Trainer):
             self._rows(centers),
             ctx_rows,
             self._rows(pools.reshape(-1)),
-            lr=self.lr,
+            lr=lr,
             lam=self.negatives / pn,
             window=self.window,
             centers_per_block=pc,
@@ -517,6 +564,72 @@ class Word2VecTrainer(Trainer):
             PackedTableState(table=in_t, slots=state.in_table.slots),
             PackedTableState(table=out_t, slots=state.out_table.slots),
         ), loss, jnp.int32(0)
+
+    def _substep_grouped_mesh(self, state: W2VState, centers, ctxs, rng, lr):
+        """Center-major collective substep — the grouped plane under a mesh.
+
+        The single-kernel grouped/resident substeps need both whole tables on
+        one chip; with row-sharded tables the same center-major traffic cut
+        runs through the shard_map transfer planes instead: pull each center
+        row ONCE per window (vs once per pair on the flat path), score the
+        whole window + shared pool against it on the MXU, push one merged
+        center gradient. Row movement inside each shard is the row-DMA
+        kernel plane (pull_collective_packed / _ppush, which also honors
+        push_mode: bucketed); cross-shard movement is one psum over `model`
+        per pull and one all_gather over `data` per push — the same
+        collectives as the reference's pull/push RPC fan-out
+        (global_pull_access.h:40-55, global_push_access.h:36-53).
+
+        Pads (ctx slot -1) ride as row id == capacity: no shard owns them,
+        so they pull zeros and their (mask-zeroed) gradients are dropped on
+        push. Semantics are the DETERMINISTIC merged update (merge_push_value
+        parity), not the kernel's hogwild — strictly closer to the faithful
+        path. ``resident: 1`` has no mesh meaning (VMEM residency is
+        per-chip) and quietly uses this plane.
+        """
+        n = centers.shape[0]
+        cw = ctxs.shape[1]
+        pc = min(self.centers_per_block, n)
+        while n % pc:
+            pc -= 1
+        nb = n // pc
+        pn = self.pool_size
+        lam = self.negatives / pn
+        inv_b = 1.0 / (n * (self.window + 1))
+        pools = alias_sample(self.neg_alias, rng, (nb, pn))
+
+        cap = self.capacity
+        center_rows = self._rows(centers)
+        ctx_rows = jnp.where(ctxs >= 0, self._rows(jnp.maximum(ctxs, 0)), cap)
+        pool_rows = self._rows(pools.reshape(-1))
+        mask = (ctxs >= 0).astype(jnp.float32)  # [n, cw]
+
+        v = self._ppull(state.in_table, center_rows)  # [n, S, L]
+        out_pull_rows = jnp.concatenate([ctx_rows.reshape(-1), pool_rows])
+        u_all = self._ppull(state.out_table, out_pull_rows)
+        u = u_all[: n * cw].reshape((n, cw) + u_all.shape[1:])
+        q = u_all[n * cw :].reshape((nb, pn) + u_all.shape[1:])
+
+        def loss_of(v, u, q):
+            pos = jnp.einsum("ncsl,nsl->nc", u, v,
+                             preferred_element_type=jnp.float32)
+            vb = v.reshape((nb, pc) + v.shape[1:])
+            neg = jnp.einsum("npsl,nqsl->npq", vb, q,
+                             preferred_element_type=jnp.float32)
+            n_real = mask.sum(axis=1).reshape(nb, pc, 1)  # pool weight/center
+            return -inv_b * (
+                jnp.sum(jax.nn.log_sigmoid(pos) * mask)
+                + lam * jnp.sum(jax.nn.log_sigmoid(-neg) * n_real)
+            )
+
+        loss, (dv, du, dq) = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(v, u, q)
+        out_grads = jnp.concatenate(
+            [du.reshape((n * cw,) + du.shape[2:]),
+             dq.reshape((nb * pn,) + dq.shape[2:])]
+        )
+        in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr)
+        out_table, d2 = self._ppush(state.out_table, out_pull_rows, out_grads, lr)
+        return W2VState(in_table, out_table), loss, d1 + d2
 
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
         """Packed tables with reference-faithful per-pair K negatives."""
@@ -553,9 +666,18 @@ class Word2VecTrainer(Trainer):
         t = max(n // self.batch_size, 1)
         b = n // t
         if self.fused and self.grouped:
-            substep = self._substep_grouped
+            substep = (
+                self._substep_grouped_mesh
+                if self.mesh is not None
+                else self._substep_grouped
+            )
         elif self.fused:
-            substep = self._substep_fused
+            # flat fused has no collective plane; under a mesh the pooled
+            # packed substep is its equivalent (same math, transfer plane)
+            substep = (
+                self._substep_packed if self.mesh is not None
+                else self._substep_fused
+            )
         elif self.packed:
             substep = (
                 self._substep_packed

@@ -32,11 +32,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(in_rows_ref, pos_rows_ref, pool_rows_ref,
+def _kernel(in_rows_ref, pos_rows_ref, pool_rows_ref, lr_ref,
             in_t_in, out_t_in, in_table, out_table, loss_ref,
             v_buf, u_buf, p_buf, read_sems, write_sems,
-            *, lr, lam, inv_b, pairs, pool):
+            *, lam, inv_b, pairs, pool):
     del in_t_in, out_t_in
+    # lr rides scalar prefetch (SMEM) so a decay schedule never recompiles
+    lr = lr_ref[0]
     P, PN = pairs, pool
     i = pl.program_id(0)
     nblocks = pl.num_programs(0)
@@ -162,10 +164,10 @@ def _last_occurrence(rows: jax.Array, valid: jax.Array) -> jax.Array:
 
 
 def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
-                    nwc_ref, nwu_ref, pool_rows_ref, mask_in, in_t_in,
+                    nwc_ref, nwu_ref, pool_rows_ref, lr_ref, mask_in, in_t_in,
                     out_t_in, in_table, out_table, loss_ref,
                     v_buf, u_buf, p_buf, read_sems, write_sems,
-                    *, lr, lam, inv_b, pc, cw, pool):
+                    *, lam, inv_b, pc, cw, pool):
     """Center-major fused SGNS substep (see fused_sgns_grouped_step).
 
     The flat kernel issues ~4.25 row copies per pair; per-copy issue cost is
@@ -178,6 +180,7 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
     bit-identical with ~dup-fraction fewer write copies.
     """
     del in_t_in, out_t_in
+    lr = lr_ref[0]
     PC, CW, PN = pc, cw, pool
     i = pl.program_id(0)
     nblocks = pl.num_programs(0)
@@ -310,7 +313,7 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("lr", "lam", "centers_per_block", "pool_size", "window",
+    static_argnames=("lam", "centers_per_block", "pool_size", "window",
                      "interpret"),
     donate_argnums=(0, 1),
 )
@@ -375,10 +378,10 @@ def fused_sgns_grouped_step(
     c_packed = (c_blocks | jnp.where(c_last, 1 << 30, 0)).reshape(-1)
 
     kern = functools.partial(
-        _grouped_kernel, lr=lr, lam=lam, inv_b=inv_b, pc=pc, cw=cw, pool=pn
+        _grouped_kernel, lam=lam, inv_b=inv_b, pc=pc, cw=cw, pool=pn
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=7,
+        num_scalar_prefetch=8,
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec((1, cw, pc), lambda i, *_: (i, 0, 0)),  # mask
@@ -406,7 +409,7 @@ def fused_sgns_grouped_step(
             jax.ShapeDtypeStruct(out_table.shape, out_table.dtype),
             jax.ShapeDtypeStruct((nblocks, 8, 128), jnp.float32),
         ),
-        input_output_aliases={8: 0, 9: 1},
+        input_output_aliases={9: 0, 10: 1},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(
@@ -417,6 +420,7 @@ def fused_sgns_grouped_step(
         nwrite_c,
         nwrite_u,
         pool_rows.astype(jnp.int32),
+        jnp.asarray(lr, jnp.float32).reshape(1),
         mask,
         in_table,
         out_table,
@@ -426,13 +430,13 @@ def fused_sgns_grouped_step(
 
 def _resident_kernel(ccold_rows_ref, ccold_slot_ref, ncc_ref, nwc_ref,
                      ctx_rows_ref, ctx_slot_ref, nctx_ref, nwu_ref,
-                     pcold_rows_ref, pcold_slot_ref, npc_ref, nwp_ref,
+                     pcold_rows_ref, pcold_slot_ref, npc_ref, nwp_ref, lr_ref,
                      hot_c_in, hot_u_in, hot_p_in, cold_u_in, mask_in,
                      in_t_in, out_t_in,
                      in_table, out_table, loss_ref,
                      v_buf, u_buf, p_buf, hot_in, hot_out,
                      read_sems, write_sems, bulk_sem,
-                     *, lr, lam, inv_b, pc, cw, pool, hot_n, ch):
+                     *, lam, inv_b, pc, cw, pool, hot_n, ch):
     """Grouped kernel + VMEM-resident head rows (see fused_sgns_resident_step).
 
     The grouped kernel's throughput is bound by per-row DMA issue rate, and
@@ -454,6 +458,7 @@ def _resident_kernel(ccold_rows_ref, ccold_slot_ref, ncc_ref, nwc_ref,
     last-write-wins it replaces.
     """
     del in_t_in, out_t_in
+    lr = lr_ref[0]
     PC, CW, PN, HOT, CH = pc, cw, pool, hot_n, ch
     i = pl.program_id(0)
     nblocks = pl.num_programs(0)
@@ -643,6 +648,49 @@ def _resident_kernel(ccold_rows_ref, ccold_slot_ref, ncc_ref, nwc_ref,
         bulk_wait()
 
 
+def effective_hot_rows(hot_rows: int, *capacities: int) -> tuple[int, int]:
+    """(hot_n, ch): the resident row count the kernel will actually use.
+
+    ``hot_rows`` is clipped to the table capacities and rounded down to the
+    one-hot chunk size (256, or a multiple of 8 below 256). Exposed so
+    callers (the trainer, logs) can see the real value instead of a silent
+    round-down; returns ``(0, 0)`` when no resident rows are possible.
+    """
+    hot_n = min(hot_rows, *capacities)
+    if hot_n >= 256:
+        hot_n -= hot_n % 256
+        ch = 256
+    else:
+        hot_n -= hot_n % 8
+        ch = hot_n
+    return (hot_n, ch) if hot_n > 0 else (0, 0)
+
+
+# Mosaic scoped-VMEM grant for the resident kernel (see CompilerParams
+# below); the budget check keeps a margin for Mosaic's own temporaries.
+_RESIDENT_VMEM_BYTES = 100 * 1024 * 1024
+
+
+def _check_resident_vmem(hot_n, pc, cap, pn, row_shape, dtype):
+    """Fail fast with a clear message instead of a Mosaic stack OOM."""
+    import math
+
+    row_bytes = math.prod(row_shape) * jnp.dtype(dtype).itemsize
+    dp_f32 = math.prod(row_shape) * 4
+    scratch = (2 * (pc + cap + pn) + 2 * hot_n) * row_bytes
+    # f32 working set: merged slot values + grads for cap/pc/pn slots, twice
+    # over for where-selects and update temporaries
+    working = 4 * dp_f32 * (cap + pc + pn)
+    need = scratch + working
+    if need > _RESIDENT_VMEM_BYTES:
+        raise ValueError(
+            f"resident kernel VMEM estimate {need / 2**20:.1f} MiB exceeds "
+            f"the {_RESIDENT_VMEM_BYTES / 2**20:.0f} MiB budget "
+            f"(hot_rows={hot_n}, centers_per_block={pc}, ctx slots={cap}, "
+            f"pool={pn}); lower hot_rows or centers_per_block"
+        )
+
+
 def _cold_compact(rows, is_cold, slot_bits=20):
     """Compact cold entries to the front of each block's copy list.
 
@@ -665,7 +713,7 @@ def _cold_compact(rows, is_cold, slot_bits=20):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("lr", "lam", "centers_per_block", "pool_size", "window",
+    static_argnames=("lam", "centers_per_block", "pool_size", "window",
                      "hot_rows", "interpret"),
     donate_argnums=(0, 1),
 )
@@ -703,15 +751,18 @@ def fused_sgns_resident_step(
     if cap > _SLOT_MASK:
         raise ValueError(f"centers_per_block*2*window {cap} exceeds slot bits")
 
-    hot_n = min(hot_rows, in_table.shape[0], out_table.shape[0])
-    if hot_n >= 256:
-        hot_n -= hot_n % 256
-        ch = 256
-    else:
-        hot_n -= hot_n % 8
-        ch = hot_n
+    # the bulk DMA retires both tables' copies on one semaphore with
+    # equal-size waits — only sound when the row shapes/dtypes agree
+    if in_table.shape[1:] != out_table.shape[1:] or in_table.dtype != out_table.dtype:
+        raise ValueError(
+            f"in/out tables must share row shape and dtype, got "
+            f"{in_table.shape[1:]}/{in_table.dtype} vs "
+            f"{out_table.shape[1:]}/{out_table.dtype}"
+        )
+    hot_n, ch = effective_hot_rows(hot_rows, in_table.shape[0], out_table.shape[0])
     if hot_n <= 0:
         raise ValueError("hot_rows too small; use fused_sgns_grouped_step")
+    _check_resident_vmem(hot_n, pc, cap, pn, in_table.shape[1:], in_table.dtype)
 
     # [CW, PC] orientation throughout (PC = lanes): flat slot k = c*PC + p
     flat = (
@@ -735,11 +786,11 @@ def fused_sgns_resident_step(
     pc_rows, pc_slot, npc, nwp = _cold_compact(p_blocks, ~p_hot)
 
     kern = functools.partial(
-        _resident_kernel, lr=lr, lam=lam, inv_b=inv_b, pc=pc, cw=cw, pool=pn,
+        _resident_kernel, lam=lam, inv_b=inv_b, pc=pc, cw=cw, pool=pn,
         hot_n=hot_n, ch=ch,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=12,
+        num_scalar_prefetch=13,
         grid=(nblocks,),
         in_specs=[
             # [NB, 1, K] with block (1, 1, K): Mosaic wants the last two
@@ -776,13 +827,20 @@ def fused_sgns_resident_step(
             jax.ShapeDtypeStruct(out_table.shape, out_table.dtype),
             jax.ShapeDtypeStruct((nblocks, 8, 128), jnp.float32),
         ),
-        input_output_aliases={17: 0, 18: 1},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        input_output_aliases={18: 0, 19: 1},
+        # resident buffers + double-buffered cold slots + expansion
+        # intermediates exceed the default 16 MiB scoped-vmem budget; v5e has
+        # 128 MiB VMEM — grant the kernel what it actually uses (same
+        # constant the fail-fast budget check validates against)
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, vmem_limit_bytes=_RESIDENT_VMEM_BYTES
+        ),
         interpret=interpret,
     )(
         cc_rows.reshape(-1), cc_slot.reshape(-1), ncc, nwc,
         ctx_rows.reshape(-1), ctx_slot.reshape(-1), nctx, nwu,
         pc_rows.reshape(-1), pc_slot.reshape(-1), npc, nwp,
+        jnp.asarray(lr, jnp.float32).reshape(1),
         hot_c_idx[:, None, :], hot_u_idx[:, None, :], hot_p_idx[:, None, :],
         cold_u[:, None, :], mask,
         in_table, out_table,
@@ -790,9 +848,346 @@ def fused_sgns_resident_step(
     return new_in, new_out, loss_parts[:, 0, 0].sum()
 
 
+def _dedup_kernel(c_rows_ref, u_list_ref, nu_ref,
+                  ctx_rows_ref, ctx_slot_ref, nctx_ref, nwu_ref,
+                  pool_rows_ref, lr_ref,
+                  uidx_in, direct_in, mask_in, in_t_in, out_t_in,
+                  in_table, out_table, loss_ref,
+                  v_buf, u_buf, p_buf, u_uniq,
+                  read_sems, write_sems,
+                  *, lam, inv_b, pc, cw, pool, u_cap, ch):
+    """Center-major fused SGNS with per-block READ dedup of context rows.
+
+    With block-ordered batches (adjacent windows overlap), a block of PC
+    consecutive centers touches ~PC DISTINCT context rows across ~PC*(w+1)
+    real slots. Instead of one DMA per SLOT (the grouped kernel), each
+    distinct row is DMA'd ONCE into a compacted unique buffer and broadcast
+    to its slots by a one-hot MXU matmul; updates accumulate back through
+    the transpose (exact merged gradients per distinct row — the
+    reference's merge_push_value semantics, sparsetable.h:176-179 — written
+    back with ONE DMA per distinct row). Rows beyond the ``u_cap`` static
+    unique capacity fall back to the grouped kernel's per-slot hogwild
+    treatment, so correctness never depends on the locality assumption.
+    """
+    del in_t_in, out_t_in
+    lr = lr_ref[0]
+    PC, CW, PN, UC, CH = pc, cw, pool, u_cap, ch
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+    cap = PC * CW
+    dp = in_table.shape[1] * in_table.shape[2]
+    f32 = jnp.float32
+
+    def dmas(b, slot, table_dir):
+        read = table_dir == "read"
+        sems = read_sems if read else write_sems
+
+        def mk(buf_at, table, row):
+            pair = (table.at[row], buf_at)
+            src, dst = pair if read else pair[::-1]
+            return pltpu.make_async_copy(src, dst, sems.at[slot])
+
+        def v_dma(p, _):
+            v = c_rows_ref[b * PC + p]
+            if read:
+                mk(v_buf.at[slot, p], in_table, v & _ROW_MASK).start()
+            else:
+                @pl.when((v >> 30) != 0)
+                def _():
+                    mk(v_buf.at[slot, p], in_table, v & _ROW_MASK).start()
+            return 0
+
+        def u_dma(k, _):  # direct (overflow) ctx slots, per-slot
+            s = ctx_slot_ref[b * cap + k]
+            row = ctx_rows_ref[b * cap + k]
+            if read:
+                mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
+            else:
+                @pl.when((s >> 20) != 0)
+                def _():
+                    mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
+            return 0
+
+        def p_dma(q, _):
+            mk(p_buf.at[slot, q], out_table, pool_rows_ref[b * PN + q]).start()
+            return 0
+
+        def uq_dma(j, _):  # one DMA per DISTINCT ctx row
+            mk(u_uniq.at[slot, j], out_table, u_list_ref[b * UC + j]).start()
+            return 0
+
+        jax.lax.fori_loop(0, PC, v_dma, 0)
+        jax.lax.fori_loop(0, nctx_ref[b], u_dma, 0)
+        jax.lax.fori_loop(0, PN, p_dma, 0)
+        jax.lax.fori_loop(0, nu_ref[b], uq_dma, 0)
+
+    def wait_all(b, slot, table_dir):
+        read = table_dir == "read"
+        sems = read_sems if read else write_sems
+        # nwu_ref packs direct-ctx writes (low 16 bits) and center
+        # last-occurrence writes (high bits) — see the wrapper
+        count = (
+            PC + nctx_ref[b] + PN + nu_ref[b]
+            if read
+            else (nwu_ref[b] & 0xFFFF) + (nwu_ref[b] >> 16) + PN + nu_ref[b]
+        )
+
+        def w(j, _):
+            pltpu.make_async_copy(
+                v_buf.at[slot, 0], v_buf.at[slot, 0], sems.at[slot]
+            ).wait()
+            return 0
+
+        jax.lax.fori_loop(0, count, w, 0)
+
+    @pl.when(i == 0)
+    def _():
+        dmas(0, 0, "read")
+
+    @pl.when(i + 1 < nblocks)
+    def _():
+        slot_next = (i + 1) % 2
+
+        @pl.when(i >= 1)
+        def _():
+            wait_all(i - 1, slot_next, "write")
+
+        dmas(i + 1, slot_next, "read")
+
+    slot = i % 2
+    wait_all(i, slot, "read")
+
+    # ---- broadcast unique rows to their slots (one-hot MXU) --------------
+    uidx = uidx_in[0, 0]  # [cap] i32, sentinel UC on pads/direct
+    direct_real = direct_in[0, 0][:, None] > 0  # [cap, 1]
+    mask = mask_in[0]  # [CW, PC]
+
+    acc = jnp.zeros((cap, dp), f32)
+    for c0 in range(0, UC, CH):
+        j = jax.lax.broadcasted_iota(jnp.int32, (cap, CH), 1) + c0
+        h = (j == uidx[:, None]).astype(f32)
+        # entries >= nu were never DMA'd: 0 * poison-NaN would still be
+        # NaN, so zero them by value before the matmul
+        ji = jax.lax.broadcasted_iota(jnp.int32, (CH, 1), 0) + c0
+        uq = jnp.where(
+            ji < nu_ref[i],
+            u_uniq[slot, pl.ds(c0, CH)].reshape(CH, dp).astype(f32), 0.0)
+        acc = acc + jax.lax.dot_general(
+            h, uq, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+    is_dedup = uidx[:, None] < UC  # [cap, 1]
+
+    vv = v_buf[slot].astype(f32).reshape(PC, dp)
+    uu = jnp.where(
+        is_dedup, acc,
+        jnp.where(direct_real, u_buf[slot].astype(f32).reshape(cap, dp), 0.0))
+    pv = p_buf[slot].astype(f32).reshape(PN, dp)
+
+    # ---- compute (identical math to the grouped kernel) ------------------
+    uu3 = uu.reshape(CW, PC, dp)
+    pos = jnp.sum(uu3 * vv[None, :, :], axis=-1)
+    n_real = jnp.sum(mask, axis=0, keepdims=True)
+    neg = jax.lax.dot_general(
+        vv, pv, (((1,), (1,)), ((), ())), preferred_element_type=f32)
+
+    g_pos = (jax.nn.sigmoid(pos) - 1.0) * inv_b * mask
+    g_neg = (lam * inv_b) * jax.nn.sigmoid(neg) * n_real.reshape(PC, 1)
+
+    dv = jnp.sum(g_pos[:, :, None] * uu3, axis=0) + jax.lax.dot_general(
+        g_neg, pv, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+    du_flat = (g_pos[:, :, None] * vv[None, :, :]).reshape(cap, dp)
+    dq = jax.lax.dot_general(
+        g_neg, vv, (((0,), (0,)), ((), ())), preferred_element_type=f32)
+
+    v_shape = v_buf[slot].shape
+    v_buf[slot] = (vv - lr * dv).reshape(v_shape).astype(v_buf.dtype)
+    u_buf[slot] = (
+        (uu - lr * du_flat).reshape(u_buf[slot].shape).astype(u_buf.dtype))
+    p_buf[slot] = (pv - lr * dq).reshape(p_buf[slot].shape).astype(p_buf.dtype)
+
+    # ---- merged updates of the unique rows (one-hot transpose) -----------
+    for c0 in range(0, UC, CH):
+        jt = jax.lax.broadcasted_iota(jnp.int32, (CH, cap), 0) + c0
+        ht = (jt == uidx[None, :]).astype(f32)
+        d_u = jax.lax.dot_general(
+            ht, du_flat, (((1,), (0,)), ((), ())), preferred_element_type=f32)
+        u_uniq[slot, pl.ds(c0, CH)] = (
+            u_uniq[slot, pl.ds(c0, CH)].reshape(CH, dp).astype(f32) - lr * d_u
+        ).reshape((CH,) + u_uniq.shape[2:]).astype(u_uniq.dtype)
+
+    loss = -(
+        jnp.sum(jax.nn.log_sigmoid(pos) * mask)
+        + lam * jnp.sum(jax.nn.log_sigmoid(-neg) * n_real.reshape(PC, 1))
+    )
+    loss_ref[...] = jnp.full(loss_ref.shape, loss * inv_b, dtype=jnp.float32)
+
+    dmas(i, slot, "write")
+
+    @pl.when(i == nblocks - 1)
+    def _():
+        wait_all(i, slot, "write")
+
+        @pl.when(nblocks >= 2)
+        def _():
+            wait_all(i - 1, (i - 1) % 2, "write")
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("lr", "lam", "pairs_per_block", "pool_size", "interpret"),
+    static_argnames=("lam", "centers_per_block", "pool_size", "window",
+                     "u_cap", "interpret"),
+    donate_argnums=(0, 1),
+)
+def fused_sgns_dedup_step(
+    in_table: jax.Array,
+    out_table: jax.Array,
+    centers: jax.Array,  # [N] row ids
+    ctxs: jax.Array,  # [N, CW] row ids, -1 = pad
+    pool_rows: jax.Array,  # [N // centers_per_block * pool_size]
+    lr,
+    lam: float,
+    window: int,
+    centers_per_block: int = 256,
+    pool_size: int = 64,
+    u_cap: int = 512,
+    interpret: bool = False,
+):
+    """Center-major fused substep with per-block context-read dedup.
+
+    Returns (in_table, out_table, loss). Designed for BLOCK-ORDERED batches
+    (``data.sampler.batch_stream_blocks``): consecutive windows overlap, so
+    each block's ~PC*(w+1) real context slots hit only ~PC distinct rows —
+    one read DMA + one merged write DMA per distinct row instead of one per
+    slot. Distinct rows are assigned (in ascending row order) to the first
+    ``u_cap`` unique buffer entries; overflow rows keep the grouped
+    kernel's per-slot hogwild treatment. Semantics: deduped rows get exact
+    merged gradient sums (deterministic); centers/pool/overflow match
+    :func:`fused_sgns_grouped_step`.
+    """
+    n, cw = ctxs.shape
+    pc, pn = centers_per_block, pool_size
+    if n % pc:
+        raise ValueError(f"centers {n} not a multiple of centers_per_block {pc}")
+    nblocks = n // pc
+    if pool_rows.shape[0] != nblocks * pn:
+        raise ValueError(f"pool_rows {pool_rows.shape[0]} != {nblocks * pn}")
+    if u_cap % 8 or u_cap <= 0:
+        raise ValueError(f"u_cap must be a positive multiple of 8, got {u_cap}")
+    cap = pc * cw
+    inv_b = 1.0 / (n * (window + 1))
+    # write counts pack (direct-ctx | centers << 16) into one i32, so the
+    # per-block slot count must fit 16 bits (stricter than _SLOT_MASK)
+    if cap >= (1 << 16):
+        raise ValueError(
+            f"centers_per_block*2*window {cap} exceeds the 16-bit write-count "
+            "packing; lower centers_per_block")
+    if in_table.shape[0] > _ROW_MASK or out_table.shape[0] > _ROW_MASK:
+        raise ValueError("table capacity exceeds 2^30 (row-id flag bit)")
+    if in_table.shape[1:] != out_table.shape[1:] or in_table.dtype != out_table.dtype:
+        raise ValueError("in/out tables must share row shape and dtype")
+
+    big = jnp.int32(2**31 - 1)
+    flat = (
+        ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
+    ).astype(jnp.int32)
+    valid = flat >= 0
+
+    keyed = jnp.where(valid, flat, big)
+    order = jnp.argsort(keyed, axis=1, stable=True)
+    sr = jnp.take_along_axis(keyed, order, axis=1)
+    head = jnp.concatenate(
+        [jnp.ones((nblocks, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1
+    ) & (sr != big)
+    ranks_sorted = jnp.cumsum(head, axis=1) - 1  # unique rank per sorted pos
+    rank = jnp.zeros((nblocks, cap), jnp.int32)
+    rank = rank.at[jnp.arange(nblocks)[:, None], order].set(ranks_sorted)
+    in_list = valid & (rank < u_cap)
+    direct = valid & ~in_list
+    uidx = jnp.where(in_list, rank, u_cap).astype(jnp.int32)
+
+    tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
+    u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)
+    u_list = u_list.at[jnp.arange(nblocks)[:, None], tgt].set(
+        jnp.where(head, sr, 0)
+    )[:, :u_cap]
+    nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
+
+    ctx_rows, ctx_slot, nctx_direct, nwu_direct = _cold_compact(flat, direct)
+    mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
+    direct_real = direct.astype(jnp.float32)
+
+    c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
+    c_last = _last_occurrence(c_blocks, jnp.ones_like(c_blocks, bool))
+    nwrite_c = c_last.sum(axis=1).astype(jnp.int32)
+    c_packed = (c_blocks | jnp.where(c_last, 1 << 30, 0)).reshape(-1)
+    # write-count packing: nwu_ref carries direct-ctx writes (low 16) and
+    # center writes (high bits) — the cap < 2^16 guard above bounds both
+    nw_packed = (nwu_direct | (nwrite_c << 16)).astype(jnp.int32)
+
+    # one-hot chunk size must DIVIDE u_cap (the ds() slices tile it exactly)
+    ch = next(d for d in (256, 128, 64, 32, 16, 8) if u_cap % d == 0)
+    kern = functools.partial(
+        _dedup_kernel, lam=lam, inv_b=inv_b, pc=pc, cw=cw, pool=pn,
+        u_cap=u_cap, ch=ch,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=9,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((1, 1, cap), lambda i, *_: (i, 0, 0)),  # uidx
+            pl.BlockSpec((1, 1, cap), lambda i, *_: (i, 0, 0)),  # direct
+            pl.BlockSpec((1, cw, pc), lambda i, *_: (i, 0, 0)),  # mask
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 8, 128), lambda i, *_: (i, 0, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, pc) + in_table.shape[1:], in_table.dtype),
+            pltpu.VMEM((2, cap) + out_table.shape[1:], out_table.dtype),
+            pltpu.VMEM((2, pn) + out_table.shape[1:], out_table.dtype),
+            pltpu.VMEM((2, u_cap) + out_table.shape[1:], out_table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    new_in, new_out, loss_parts = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(in_table.shape, in_table.dtype),
+            jax.ShapeDtypeStruct(out_table.shape, out_table.dtype),
+            jax.ShapeDtypeStruct((nblocks, 8, 128), jnp.float32),
+        ),
+        input_output_aliases={12: 0, 13: 1},
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, vmem_limit_bytes=_RESIDENT_VMEM_BYTES
+        ),
+        interpret=interpret,
+    )(
+        c_packed,
+        u_list.reshape(-1),
+        nu,
+        ctx_rows.reshape(-1),
+        ctx_slot.reshape(-1),
+        nctx_direct,
+        nw_packed,
+        pool_rows.astype(jnp.int32),
+        jnp.asarray(lr, jnp.float32).reshape(1),
+        uidx[:, None, :],
+        direct_real[:, None, :],
+        mask,
+        in_table,
+        out_table,
+    )
+    return new_in, new_out, loss_parts[:, 0, 0].sum()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("lam", "pairs_per_block", "pool_size", "interpret"),
     donate_argnums=(0, 1),
 )
 def fused_sgns_step(
@@ -824,10 +1219,10 @@ def fused_sgns_step(
         )
     c, s, lanes = in_table.shape
     kern = functools.partial(
-        _kernel, lr=lr, lam=lam, inv_b=1.0 / b, pairs=p, pool=pn
+        _kernel, lam=lam, inv_b=1.0 / b, pairs=p, pool=pn
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -854,13 +1249,14 @@ def fused_sgns_step(
             jax.ShapeDtypeStruct(out_table.shape, out_table.dtype),
             jax.ShapeDtypeStruct((nblocks, 8, 128), jnp.float32),
         ),
-        input_output_aliases={3: 0, 4: 1},
+        input_output_aliases={4: 0, 5: 1},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(
         in_rows.astype(jnp.int32),
         pos_rows.astype(jnp.int32),
         pool_rows.astype(jnp.int32),
+        jnp.asarray(lr, jnp.float32).reshape(1),
         in_table,
         out_table,
     )

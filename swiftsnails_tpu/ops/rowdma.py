@@ -311,5 +311,277 @@ def scatter_write_rows(
     )(rows.astype(jnp.int32), table, values)
 
 
+# ------------------------------------------------------- fused AdaGrad ---
+
+
+def _adagrad_kernel(rows_ref, lr_ref, table_in, accum_in, deltas_ref,
+                    table_ref, accum_ref, p_scr, a_scr, read_sems, write_sems,
+                    *, eps):
+    """Read-modify-write AdaGrad on UNIQUE rows, slot math in-kernel.
+
+    Per row: DMA param + accum in, ``accum += g²``,
+    ``param -= lr * g * rsqrt(accum + eps)``, DMA both back — one kernel
+    launch for table AND slot (the unfused path costs 2 launches per slot
+    array, docs/ARCHITECTURE.md known-limitations r2). Same double-buffered
+    schedule as ``_scatter_kernel``; both DMAs of a row share the per-slot
+    semaphore (equal sizes — param and accum rows are same shape/dtype).
+    """
+    del table_in, accum_in
+    lr = lr_ref[0]
+    R = p_scr.shape[1]
+    C = table_ref.shape[0]
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    def dma(b, slot, j, buf, hbm, read):
+        pair = (hbm.at[rows_ref[b * R + j]], buf.at[slot, j])
+        src, dst = pair if read else pair[::-1]
+        sems = read_sems if read else write_sems
+        return pltpu.make_async_copy(src, dst, sems.at[slot])
+
+    def for_valid(b, fn):
+        def body(j, _):
+            @pl.when(rows_ref[b * R + j] < C)
+            def _():
+                fn(j)
+            return 0
+        jax.lax.fori_loop(0, R, body, 0)
+
+    def start_reads(b, slot):
+        def go(j):
+            dma(b, slot, j, p_scr, table_ref, True).start()
+            dma(b, slot, j, a_scr, accum_ref, True).start()
+        for_valid(b, go)
+
+    def wait(b, slot, read):
+        def go(j):
+            for _ in range(2):  # param + accum copies, equal sizes
+                sems = read_sems if read else write_sems
+                pltpu.make_async_copy(
+                    p_scr.at[slot, 0], p_scr.at[slot, 0], sems.at[slot]
+                ).wait()
+        for_valid(b, go)
+
+    @pl.when(i == 0)
+    def _():
+        start_reads(0, 0)
+
+    @pl.when(i + 1 < nblocks)
+    def _():
+        slot_next = (i + 1) % 2
+
+        @pl.when(i >= 1)
+        def _():
+            wait(i - 1, slot_next, False)
+
+        start_reads(i + 1, slot_next)
+
+    slot = i % 2
+    wait(i, slot, True)
+
+    g = deltas_ref[...].astype(jnp.float32)
+    accum = a_scr[slot].astype(jnp.float32) + g * g
+    step = lr * g * jax.lax.rsqrt(accum + eps)
+    p_scr[slot] = (p_scr[slot].astype(jnp.float32) - step).astype(p_scr.dtype)
+    a_scr[slot] = accum.astype(a_scr.dtype)
+
+    def writeback(j):
+        dma(i, slot, j, p_scr, table_ref, False).start()
+        dma(i, slot, j, a_scr, accum_ref, False).start()
+    for_valid(i, writeback)
+
+    @pl.when(i == nblocks - 1)
+    def _():
+        wait(i, slot, False)
+
+        @pl.when(nblocks >= 2)
+        def _():
+            wait(i - 1, (i - 1) % 2, False)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("eps", "block_rows", "interpret"),
+    donate_argnums=(0, 1),
+)
+def scatter_adagrad_rows(
+    table: jax.Array,
+    accum: jax.Array,
+    rows: jax.Array,
+    grads: jax.Array,
+    lr,
+    eps: float = 1e-8,
+    block_rows: int = 512,
+    interpret: bool = False,
+):
+    """Fused AdaGrad RMW for UNIQUE rows: ``accum += g²; table -= lr * g *
+    rsqrt(accum + eps)`` in one kernel launch (packed layout, both buffers
+    donated/aliased). Rows ``>= capacity`` are padding and skipped. ``accum``
+    must match ``table``'s shape/dtype (the shared-semaphore byte accounting
+    relies on it). Exact merged-AdaGrad semantics for pre-merged rows —
+    bit-identical to ``AdaGradAccess.apply_push_value`` on the same inputs.
+    """
+    n = rows.shape[0]
+    c, s, lanes = table.shape
+    if n % block_rows:
+        raise ValueError(f"N={n} not a multiple of block_rows={block_rows}")
+    if accum.shape != table.shape or accum.dtype != table.dtype:
+        raise ValueError(
+            f"accum {accum.shape}/{accum.dtype} must match table "
+            f"{table.shape}/{table.dtype}"
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block_rows, s, lanes), lambda i, *_: (i, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows, s, lanes), table.dtype),
+            pltpu.VMEM((2, block_rows, s, lanes), accum.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_adagrad_kernel, eps=eps),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct(table.shape, table.dtype),
+            jax.ShapeDtypeStruct(accum.shape, accum.dtype),
+        ),
+        input_output_aliases={2: 0, 3: 1},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(
+        rows.astype(jnp.int32),
+        jnp.asarray(lr, jnp.float32).reshape(1),
+        table,
+        accum,
+        grads.astype(table.dtype),
+    )
+
+
+# -------------------------------------------- slot-fused AdaGrad (1 tile) ---
+
+
+def _adagrad_fused_kernel(rows_ref, lr_ref, table_in, deltas_ref, table_ref,
+                          scratch, read_sems, write_sems, *, eps):
+    """AdaGrad RMW where param AND accum live in ONE stored tile
+    (``table[r] = [param_row, accum_row]`` along the sublane axis): one read
+    DMA + one write DMA per row moves both, halving the issue-bound DMA
+    count of the split-buffer kernel. Rows must be unique; ``>= capacity``
+    skipped."""
+    del table_in
+    lr = lr_ref[0]
+    R = scratch.shape[1]
+    C = table_ref.shape[0]
+    i = pl.program_id(0)
+    nblocks = pl.num_programs(0)
+
+    def dma(b, slot, j, read):
+        pair = (table_ref.at[rows_ref[b * R + j]], scratch.at[slot, j])
+        src, dst = pair if read else pair[::-1]
+        sems = read_sems if read else write_sems
+        return pltpu.make_async_copy(src, dst, sems.at[slot])
+
+    def for_valid(b, fn):
+        def body(j, _):
+            @pl.when(rows_ref[b * R + j] < C)
+            def _():
+                fn(j)
+            return 0
+        jax.lax.fori_loop(0, R, body, 0)
+
+    @pl.when(i == 0)
+    def _():
+        for_valid(0, lambda j: dma(0, 0, j, True).start())
+
+    @pl.when(i + 1 < nblocks)
+    def _():
+        slot_next = (i + 1) % 2
+
+        @pl.when(i >= 1)
+        def _():
+            for_valid(i - 1, lambda j: dma(i - 1, slot_next, j, False).wait())
+
+        for_valid(i + 1, lambda j: dma(i + 1, slot_next, j, True).start())
+
+    slot = i % 2
+    for_valid(i, lambda j: dma(i, slot, j, True).wait())
+
+    g = deltas_ref[...].astype(jnp.float32)  # [R, 1, 128]
+    tile = scratch[slot].astype(jnp.float32)  # [R, 2, 128]
+    accum = tile[:, 1:2, :] + g * g
+    param = tile[:, 0:1, :] - lr * g * jax.lax.rsqrt(accum + eps)
+    scratch[slot] = jnp.concatenate([param, accum], axis=1).astype(scratch.dtype)
+
+    for_valid(i, lambda j: dma(i, slot, j, False).start())
+
+    @pl.when(i == nblocks - 1)
+    def _():
+        for_valid(i, lambda j: dma(i, slot, j, False).wait())
+
+        @pl.when(nblocks >= 2)
+        def _():
+            for_valid(i - 1, lambda j: dma(i - 1, (i - 1) % 2, j, False).wait())
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("eps", "block_rows", "interpret"),
+    donate_argnums=(0,),
+)
+def scatter_adagrad_fused_rows(
+    table: jax.Array,  # [C, 2, 128]: sublane 0 = param, sublane 1 = accum
+    rows: jax.Array,
+    grads: jax.Array,  # [N, 1, 128]
+    lr,
+    eps: float = 1e-8,
+    block_rows: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Slot-fused AdaGrad RMW for UNIQUE rows; see ``_adagrad_fused_kernel``."""
+    n = rows.shape[0]
+    c, s, lanes = table.shape
+    if s != 2:
+        raise ValueError(f"slot-fused table must be [C, 2, 128], got {table.shape}")
+    if n % block_rows:
+        raise ValueError(f"N={n} not a multiple of block_rows={block_rows}")
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n // block_rows,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((block_rows, 1, lanes), lambda i, *_: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows, 2, lanes), table.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_adagrad_fused_kernel, eps=eps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(table.shape, table.dtype),
+        input_output_aliases={2: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(
+        rows.astype(jnp.int32),
+        jnp.asarray(lr, jnp.float32).reshape(1),
+        table,
+        grads.astype(table.dtype),
+    )
+
+
 def on_tpu() -> bool:
     return jax.default_backend() in ("tpu", "axon")

@@ -57,7 +57,9 @@ def bucket_capacity(local_n: int, model: int, slack: float) -> int:
     if model <= 1:
         return local_n
     cap = -(-int(slack * local_n) // model)
-    cap = -(-cap // 8) * 8
+    # floor at one sublane group: slack * local_n < 1 must not produce a
+    # zero-row bucket (empty buckets break the gather shapes downstream)
+    cap = max(-(-cap // 8) * 8, 8)
     return min(cap, local_n)
 
 

@@ -323,6 +323,180 @@ def test_resident_matches_sequential_reference(seed, hot_rows):
     np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
 
 
+# ----------------------------------------------------------------- dedup ---
+
+
+def reference_dedup(in_t, out_t, centers, ctxs, pool_rows, lr, lam, window,
+                    pc, pn, u_cap):
+    """Sequential reference for the dedup kernel: per block, context rows
+    are ranked by ascending row id; ranks < u_cap are 'deduped' (one read
+    from the snapshot, exact merged gradient sum, one write) and the rest
+    keep the grouped kernel's per-slot semantics. Reads see writes <= b-2;
+    write order: centers, direct ctx (c-major), pool, unique (ascending)."""
+    in_t = in_t.copy()
+    out_t = out_t.copy()
+    n, cw = ctxs.shape
+    nblocks = n // pc
+    inv_b = 1.0 / (n * (window + 1))
+    d = in_t.shape[1] * in_t.shape[2]
+    shape = in_t.shape[1:]
+    total_loss = 0.0
+    snap_in, snap_out = in_t.copy(), out_t.copy()
+    for blk in range(nblocks):
+        cr = centers[blk * pc : (blk + 1) * pc]
+        cx = ctxs[blk * pc : (blk + 1) * pc]  # [pc, cw], -1 pads
+        qr = pool_rows[blk * pn : (blk + 1) * pn]
+        valid_rows = sorted({int(r) for r in cx.reshape(-1) if r >= 0})
+        uniq_rows = valid_rows[:u_cap]
+        rank = {r: i for i, r in enumerate(valid_rows)}
+        V = snap_in[cr].reshape(pc, d).astype(np.float32)
+        U = np.zeros((cw, pc, d), np.float32)
+        mask = np.zeros((cw, pc), np.float32)
+        for p in range(pc):
+            for c in range(cw):
+                if cx[p, c] >= 0:
+                    U[c, p] = snap_out[cx[p, c]].reshape(d)
+                    mask[c, p] = 1.0
+        Q = snap_out[qr].reshape(pn, d).astype(np.float32)
+        # unique rows were READ from the same <= b-2 snapshot the slots saw;
+        # their merged writeback uses that base, not the refreshed snap
+        uniq_base = {r: snap_out[r].reshape(d).copy() for r in uniq_rows}
+        snap_in, snap_out = in_t.copy(), out_t.copy()
+        pos = (U * V[None]).sum(-1)
+        n_real = mask.sum(0)
+        neg = V @ Q.T
+        g_pos = (_sigmoid(pos) - 1.0) * inv_b * mask
+        g_neg = lam * inv_b * _sigmoid(neg) * n_real[:, None]
+        dV = (g_pos[:, :, None] * U).sum(0) + g_neg @ Q
+        dU = g_pos[:, :, None] * V[None]
+        dQ = g_neg.T @ V
+        for p in range(pc):  # centers: last write wins
+            in_t[cr[p]] = (V[p] - lr * dV[p]).reshape(shape)
+        du_sum = {r: np.zeros(d, np.float32) for r in uniq_rows}
+        for c in range(cw):  # direct ctx in c-major order, later wins
+            for p in range(pc):
+                r = cx[p, c]
+                if r >= 0:
+                    if rank[int(r)] < u_cap:
+                        du_sum[int(r)] += dU[c, p]
+                    else:
+                        out_t[r] = (U[c, p] - lr * dU[c, p]).reshape(shape)
+        for q in range(pn):
+            out_t[qr[q]] = (Q[q] - lr * dQ[q]).reshape(shape)
+        for r in uniq_rows:  # merged unique writes, ascending row order
+            out_t[r] = (uniq_base[r] - lr * du_sum[r]).reshape(shape)
+        total_loss += -(
+            (np.log(_sigmoid(pos)) * mask).sum()
+            + lam * (np.log(_sigmoid(-neg)) * n_real[:, None]).sum()
+        ) * inv_b
+    return in_t, out_t, total_loss
+
+
+@pytest.mark.parametrize("seed,u_cap", [(0, 64), (1, 64), (0, 16), (0, 24)])
+def test_dedup_matches_sequential_reference(seed, u_cap):
+    """u_cap=64 (>= distinct rows: all deduped); u_cap=16: mixed dedup +
+    direct-overflow traffic; u_cap=24: one-hot chunk (8) smaller than and
+    dividing u_cap — the 384-style multi-chunk layout."""
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_dedup_step
+
+    rng = np.random.default_rng(seed)
+    C, S, L = 64, 2, 128
+    N, PC, PN, W = 32, 8, 4, 3
+    CW = 2 * W
+    in_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    out_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    centers = rng.integers(0, C, N).astype(np.int32)
+    # consecutive-ish contexts with duplicates + pads (the workload shape)
+    ctxs = (centers[:, None] + rng.integers(-3, 4, (N, CW))).astype(np.int32) % C
+    ctxs[rng.random((N, CW)) < 0.4] = -1
+    ctxs[3] = -1
+    pool_rows = rng.integers(0, C, (N // PC) * PN).astype(np.int32)
+    lr, lam = 0.05, 0.625
+
+    want_in, want_out, want_loss = reference_dedup(
+        in_t, out_t, centers, ctxs, pool_rows, lr, lam, W, PC, PN, u_cap
+    )
+    got_in, got_out, got_loss = fused_sgns_dedup_step(
+        jnp.asarray(in_t), jnp.asarray(out_t), jnp.asarray(centers),
+        jnp.asarray(ctxs), jnp.asarray(pool_rows),
+        lr=lr, lam=lam, window=W, centers_per_block=PC, pool_size=PN,
+        u_cap=u_cap, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_in), want_in, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_out), want_out, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
+
+
+def test_dedup_trainer_trains_toy_corpus():
+    """dedup: 1 end to end through the trainer (block-ordered batches),
+    CPU interpret."""
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    vocab_size = 48
+    counts = np.sort(rng.integers(1, 50, vocab_size))[::-1].astype(np.int64)
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)], counts)
+    base = np.repeat(np.arange(12), 50) % vocab_size
+    corpus = ((base + rng.integers(0, 2, base.size)) % vocab_size).astype(np.int32)
+    cfg = Config({
+        "dim": "16", "window": "2", "negatives": "2", "learning_rate": "0.1",
+        "batch_size": "64", "subsample": "0", "num_iters": "20",
+        "pool_size": "8", "pool_block": "16", "packed": "1", "fused": "1",
+        "grouped": "1", "dedup": "1", "u_cap": "32",
+        "centers_per_block": "16", "use_native": "0",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None, corpus_ids=corpus, vocab=vocab)
+    assert tr.dedup and tr.grouped
+    state = tr.init_state()
+    step = jax.jit(tr.train_step)
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for i, batch in enumerate(tr.batches()):
+        if batch["centers"].shape[0] % 64:
+            continue
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(key, i))
+        losses.append(float(m["loss"]))
+        if len(losses) >= 40:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_batch_stream_blocks_non_divisible_batch():
+    """batch_size not divisible by block: batches must still be EXACTLY
+    batch_size (train_step reshapes by it) — block shrinks to a divisor."""
+    from swiftsnails_tpu.data.sampler import batch_stream_blocks
+
+    rng = np.random.default_rng(1)
+    centers = np.arange(4000, dtype=np.int32)
+    ctxs = np.tile(centers[:, None], (1, 2))
+    for b in batch_stream_blocks(centers, ctxs, 1000, rng, block=256):
+        assert b["centers"].shape[0] == 1000
+        # 250-run blocks (largest divisor of 1000 below 256)
+        assert np.all(np.diff(b["centers"][:250]) == 1)
+
+
+def test_batch_stream_blocks_preserves_block_order():
+    from swiftsnails_tpu.data.sampler import batch_stream_blocks
+
+    rng = np.random.default_rng(0)
+    n, cw, block = 64, 4, 8
+    centers = np.arange(n, dtype=np.int32)
+    ctxs = np.tile(centers[:, None], (1, cw))
+    seen = []
+    for b in batch_stream_blocks(centers, ctxs, 16, rng, block=block):
+        c = b["centers"]
+        assert len(c) == 16
+        # each block of 8 is a consecutive run
+        for lo in range(0, 16, block):
+            blk = c[lo : lo + block]
+            assert np.all(np.diff(blk) == 1), blk
+            seen.append(blk[0])
+    assert len(set(seen)) == len(seen)  # blocks are distinct
+
+
 def test_resident_trainer_trains_toy_corpus():
     """resident: 1 end to end through the trainer (mixed hot/cold rows:
     hot_rows below vocab size), CPU interpret."""
@@ -358,6 +532,69 @@ def test_resident_trainer_trains_toy_corpus():
         if len(losses) >= 40:
             break
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_resident_trainer_hash_keys(tmp_path):
+    """resident: 1 + hash_keys: 1 — under hashing the hot set is arbitrary
+    rows < hot_n (not the frequency head); the kernel must stay correct.
+    Mirrors the grouped hash_keys test, end to end on CPU interpret."""
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(48)]
+    path = tmp_path / "c.txt"
+    with open(path, "w") as f:
+        for _ in range(400):
+            f.write(" ".join(words[i] for i in rng.integers(0, 48, 12)) + "\n")
+    cfg = Config({
+        "data": str(path), "dim": "8", "window": "2", "negatives": "2",
+        "learning_rate": "0.1", "batch_size": "64", "subsample": "0",
+        "num_iters": "1", "min_count": "1", "packed": "1",
+        "neg_mode": "pool", "pool_size": "8", "pool_block": "32",
+        "fused": "1", "grouped": "1", "resident": "1", "hot_rows": "32",
+        "hash_keys": "1", "capacity": "128", "use_native": "0",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None)
+    assert tr.resident and tr.hash_keys
+    state = tr.init_state()
+    step = jax.jit(tr.train_step, donate_argnums=(0,))
+    n = 0
+    losses = []
+    for batch in tr.batches():
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(jax.random.PRNGKey(0), n))
+        losses.append(float(m["loss"]))
+        n += 1
+        if n >= 8:
+            break
+    assert n >= 4 and all(np.isfinite(l) for l in losses)
+
+
+def test_effective_hot_rows_rounding():
+    from swiftsnails_tpu.ops.fused_sgns import effective_hot_rows
+
+    assert effective_hot_rows(1024, 1 << 20) == (1024, 256)
+    assert effective_hot_rows(300, 1 << 20) == (256, 256)  # rounds to 256
+    assert effective_hot_rows(100, 1 << 20) == (96, 96)  # multiple of 8
+    assert effective_hot_rows(1024, 24) == (24, 24)  # capacity clip
+    assert effective_hot_rows(7, 1 << 20) == (0, 0)  # too small
+    assert effective_hot_rows(4096, 1 << 20) == (4096, 256)
+
+
+def test_resident_rejects_mismatched_tables():
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_resident_step
+
+    in_t = jnp.zeros((64, 2, 128), jnp.float32)
+    out_t = jnp.zeros((64, 1, 128), jnp.float32)
+    centers = jnp.zeros((8,), jnp.int32)
+    ctxs = jnp.zeros((8, 2), jnp.int32)
+    pool = jnp.zeros((4,), jnp.int32)
+    with pytest.raises(ValueError, match="row shape"):
+        fused_sgns_resident_step(
+            in_t, out_t, centers, ctxs, pool, lr=0.1, lam=0.5, window=1,
+            centers_per_block=8, pool_size=4, hot_rows=32, interpret=True,
+        )
 
 
 def test_grouped_trainer_hash_keys_and_stream(tmp_path):

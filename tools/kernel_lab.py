@@ -123,6 +123,166 @@ def main(argv=None):
         bench(f"scatter {args.rows} XLA dtype={dtype.__name__}", scat_xla)
 
 
+def resident_lab(argv=None):
+    """Grouped vs resident fused-SGNS sweep on the real chip.
+
+    Times the two center-major kernels on a zipf-distributed workload
+    (bench-shaped: 1M vocab, dim 200, window 5, pool 64) across hot_rows /
+    centers_per_block, printing centers(words)/sec per config — the tuning
+    input for the bench's fused-resident path.
+
+        python tools/kernel_lab.py --resident [--quick]
+    """
+    p = argparse.ArgumentParser()
+    p.add_argument("--resident", action="store_true")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--vocab", type=int, default=1_000_000)
+    p.add_argument("--dim", type=int, default=200)
+    p.add_argument("--batch", type=int, default=8192)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.ops import rowdma
+    from swiftsnails_tpu.ops.fused_sgns import (
+        fused_sgns_grouped_step,
+        fused_sgns_resident_step,
+    )
+
+    interp = not rowdma.on_tpu()
+    S = -(-args.dim // rowdma.ROW_LANES)
+    CW, PN, N = 10, 64, args.batch
+    rng = np.random.default_rng(1)
+    ranks = np.arange(1, args.vocab + 1, dtype=np.float64)
+    w = 1.0 / ranks**1.05
+    cdf = np.cumsum(w) / w.sum()
+
+    def zipf(n):
+        return np.searchsorted(cdf, rng.random(n)).astype(np.int32)
+
+    centers = jnp.asarray(zipf(N))
+    ctxs_np = zipf(N * CW).reshape(N, CW)
+    ctxs_np[rng.random((N, CW)) < 0.25] = -1
+    ctxs = jnp.asarray(ctxs_np)
+    in_np = rng.random((args.vocab, S, 128), dtype=np.float32)
+
+    def timeit(fn, name, cpb, reps=12, **kw):
+        a = jnp.asarray(in_np)
+        b = jnp.zeros((args.vocab, S, 128), jnp.float32)
+        pool = jnp.asarray(zipf((N // cpb) * PN))
+        try:
+            a, b, loss = fn(a, b, centers, ctxs, pool, lr=0.025, lam=5 / PN,
+                            window=5, centers_per_block=cpb, pool_size=PN,
+                            interpret=interp, **kw)
+            _ = float(loss)
+            t0 = time.perf_counter()
+            for _i in range(reps):
+                a, b, loss = fn(a, b, centers, ctxs, pool, lr=0.025,
+                                lam=5 / PN, window=5, centers_per_block=cpb,
+                                pool_size=PN, interpret=interp, **kw)
+            _ = float(loss)  # force the donated chain through the tunnel
+            dt = (time.perf_counter() - t0) / reps
+            print(f"{name}: {dt * 1e3:.2f} ms/substep  "
+                  f"{N / dt:,.0f} words/sec")
+            return N / dt
+        except Exception as e:
+            print(f"{name} FAILED: {type(e).__name__}: {str(e)[:160]}")
+            return 0.0
+
+    cpbs = [256] if args.quick else [128, 256, 512]
+    hots = [2048] if args.quick else [1024, 2048, 4096]
+    results = {}
+    for cpb in cpbs:
+        results[f"grouped cpb={cpb}"] = timeit(
+            fused_sgns_grouped_step, f"grouped cpb={cpb}", cpb)
+        for hot in hots:
+            results[f"resident cpb={cpb} hot={hot}"] = timeit(
+                fused_sgns_resident_step, f"resident cpb={cpb} hot={hot}",
+                cpb, hot_rows=hot)
+    best = max(results, key=results.get)
+    print(f"best: {best} ({results[best]:,.0f} words/sec)")
+
+
+def ctr_lab(argv=None):
+    """CTR small-row plane vs the 2-D XLA plane on the real chip.
+
+    Measures pull+push rows/sec at the Criteo W&D shape (table_dim 17,
+    AdaGrad) on both planes, plus the fused AdaGrad RMW kernel against the
+    two-phase XLA scatter_update — the VERDICT r2 "no CTR number exists"
+    gap. Run: ``python tools/kernel_lab.py --ctr [--quick]``
+    """
+    p = argparse.ArgumentParser()
+    p.add_argument("--ctr", action="store_true")
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--capacity", type=int, default=1 << 20)
+    p.add_argument("--dim", type=int, default=17)
+    p.add_argument("--rows", type=int, default=131072)  # B=8192 x F=16
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.parallel.access import AdaGradAccess
+    from swiftsnails_tpu.parallel.store import (
+        TableState,
+        create_packed_small_table,
+        create_table,
+        pull,
+        pull_packed_small,
+        push,
+        push_packed_small,
+        small_group,
+    )
+
+    cap, dim, n = args.capacity, args.dim, args.rows
+    access = AdaGradAccess()
+    rng = np.random.default_rng(0)
+    rows = jnp.asarray(rng.integers(0, cap, n).astype(np.int32))
+    grads = jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32) * 1e-3)
+    g = small_group(dim)
+    print(f"config: capacity={cap:,} dim={dim} rows/step={n:,} "
+          f"(group={g} rows/tile, {128 // g} lanes each)")
+
+    reps = 5 if args.quick else 15
+
+    def timeit(name, make_state, step):
+        state = make_state()
+        state, probe = step(state)
+        _ = float(probe)  # force through the tunnel
+        t0 = time.perf_counter()
+        for _i in range(reps):
+            state, probe = step(state)
+        _ = float(probe)
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt * 1e3:.2f} ms  ({dt * 1e9 / n:.1f} ns/row, "
+              f"{n / dt:,.0f} rows/sec)")
+        return dt
+
+    def small_state():
+        return create_packed_small_table(cap, dim, access, seed=0)
+
+    def small_step(state):
+        vals = pull_packed_small(state, rows, dim)
+        state = push_packed_small(
+            state, rows, grads + vals * 1e-6, access, 0.01, dim)
+        return state, state.table[0, 0, 0]
+
+    def dense_state():
+        return create_table(cap, dim, access, seed=0)
+
+    def dense_step(state):
+        vals = pull(state, rows)
+        state = push(state, rows, grads + vals * 1e-6, access, 0.01)
+        return state, state.table[0, 0]
+
+    t_small = timeit("small-plane pull+push (fused AdaGrad)", small_state,
+                     jax.jit(small_step, donate_argnums=(0,)))
+    t_dense = timeit("2-D XLA plane pull+push (two-phase AdaGrad)",
+                     dense_state, jax.jit(dense_step, donate_argnums=(0,)))
+    print(f"small-row plane speedup: {t_dense / t_small:.2f}x")
+
+
 def push_lab():
     """Gather vs owner-bucketed push on the virtual CPU mesh.
 
@@ -194,5 +354,9 @@ def push_lab():
 if __name__ == "__main__":
     if "--push" in sys.argv:
         push_lab()
+    elif "--resident" in sys.argv:
+        resident_lab(sys.argv[1:])
+    elif "--ctr" in sys.argv:
+        ctr_lab(sys.argv[1:])
     else:
         main(sys.argv[1:])
