@@ -89,6 +89,10 @@ def _bind(lib, c):
             c.c_void_p, c.c_int64, c.c_int, c.c_uint64, c.c_int,
             c.c_void_p, c.c_void_p, c.c_int64,
         ]
+        lib.ssn_skipgram_windows.restype = c.c_int64
+        lib.ssn_skipgram_windows.argtypes = [
+            c.c_void_p, c.c_int64, c.c_int, c.c_uint64, c.c_int, c.c_void_p,
+        ]
         lib.ssn_subsample.restype = c.c_int64
         lib.ssn_subsample.argtypes = [
             c.c_void_p, c.c_int64, c.c_void_p, c.c_int64,
@@ -270,6 +274,24 @@ def skipgram_pairs(
     )
     assert got == n, (got, n)
     return centers, contexts
+
+
+def skipgram_windows(
+    ids: np.ndarray, window: int, seed: int = 0, dynamic: bool = True
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Center-major window schema (centers [n], contexts [n, 2w], -1 pads).
+
+    Same b-draw sequence as :func:`skipgram_pairs` for a given seed, so the
+    flat and grouped schemas generate the identical pair set.
+    """
+    lib = _require()
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    ctxs = np.empty((ids.size, 2 * window), dtype=np.int32)
+    got = lib.ssn_skipgram_windows(
+        _ptr(ids), ids.size, window, seed, int(dynamic), _ptr(ctxs)
+    )
+    assert got == ids.size, (got, ids.size)
+    return ids.copy(), ctxs
 
 
 def subsample(

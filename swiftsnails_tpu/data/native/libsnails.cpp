@@ -473,6 +473,30 @@ extern "C" int64_t ssn_skipgram_pairs(const int32_t* ids, int64_t n, int window,
   return k;
 }
 
+// Center-major windows: contexts[i, slot] for slot offsets [-w..-1, 1..w],
+// -1 where out of range or beyond the drawn b ~ U(1, window). SAME b draw
+// sequence as ssn_skipgram_pairs for a given seed, so the flat and grouped
+// schemas generate the identical pair set (the invariant the Python twins
+// keep via _dynamic_window_valid).
+extern "C" int64_t ssn_skipgram_windows(const int32_t* ids, int64_t n,
+                                        int window, uint64_t seed, int dynamic,
+                                        int32_t* ctxs /* [n, 2*window] */) {
+  uint64_t s = seed ^ 0xdeadbeefcafef00dULL;
+  const int cw = 2 * window;
+  for (int64_t i = 0; i < n; ++i) {
+    int b = dynamic ? (int)(splitmix64(s) % (uint64_t)window) + 1 : window;
+    int32_t* row = ctxs + i * cw;
+    for (int o = -window; o <= window; ++o) {
+      if (o == 0) continue;
+      int slot = o < 0 ? o + window : o + window - 1;
+      int64_t j = i + o;
+      int ab = o < 0 ? -o : o;
+      row[slot] = (j >= 0 && j < n && ab <= b) ? ids[j] : -1;
+    }
+  }
+  return n;
+}
+
 // Frequent-word subsampling: keep w with p = sqrt(t/f) + t/f (word2vec).
 // Writes kept ids to out, returns kept count.
 extern "C" int64_t ssn_subsample(const int32_t* ids, int64_t n, const int64_t* counts,

@@ -372,7 +372,12 @@ class Word2VecTrainer(Trainer):
                     # windows — the locality its unique-row copy list needs.
                     from swiftsnails_tpu.data.sampler import batch_stream_blocks
 
-                    g_c, g_x = skipgram_windows(chunk, self.window, rng)
+                    if use_native:
+                        g_c, g_x = native.skipgram_windows(
+                            chunk, self.window, seed=seed
+                        )
+                    else:
+                        g_c, g_x = skipgram_windows(chunk, self.window, rng)
                     macro = self.batch_size * self.steps_per_call
                     n_batches = max(len(g_c) // macro, 1)
                     stream = (

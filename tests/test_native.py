@@ -61,6 +61,35 @@ def test_skipgram_dynamic_within_bounds():
     np.testing.assert_array_equal(c, c2)
 
 
+def test_skipgram_windows_matches_python_full_window():
+    from swiftsnails_tpu.data.sampler import skipgram_windows as py_windows
+
+    ids = np.arange(40, dtype=np.int32)
+    c_n, x_n = native.skipgram_windows(ids, window=3, dynamic=False)
+    c_p, x_p = py_windows(ids, window=3, rng=np.random.default_rng(0),
+                          dynamic=False)
+    np.testing.assert_array_equal(c_n, c_p)
+    np.testing.assert_array_equal(x_n, x_p)  # identical slot layout + pads
+
+
+def test_skipgram_windows_same_pair_set_as_pairs():
+    """Given one seed, the native flat and window schemas must generate the
+    IDENTICAL pair multiset (same b-draw sequence) — the invariant the
+    Python twins keep via _dynamic_window_valid."""
+    ids = (np.arange(300, dtype=np.int32) * 7) % 50
+    c_f, x_f = native.skipgram_pairs(ids, window=4, seed=9, dynamic=True)
+    c_w, x_w = native.skipgram_windows(ids, window=4, seed=9, dynamic=True)
+    flat = []
+    for i in range(len(c_w)):
+        for r in x_w[i]:
+            if r >= 0:
+                flat.append((int(c_w[i]), int(r)))
+    assert sorted(flat) == sorted(zip(c_f.tolist(), x_f.tolist()))
+    # deterministic per seed
+    _, x_w2 = native.skipgram_windows(ids, window=4, seed=9, dynamic=True)
+    np.testing.assert_array_equal(x_w, x_w2)
+
+
 def test_subsample_keeps_rare():
     counts = np.array([1_000_000, 10], dtype=np.int64)
     ids = np.array([0] * 1000 + [1] * 1000, dtype=np.int32)

@@ -124,12 +124,16 @@ def main(argv=None):
 
 
 def resident_lab(argv=None):
-    """Grouped vs resident fused-SGNS sweep on the real chip.
+    """Grouped vs resident vs dedup fused-SGNS sweep on the real chip.
 
-    Times the two center-major kernels on a zipf-distributed workload
-    (bench-shaped: 1M vocab, dim 200, window 5, pool 64) across hot_rows /
-    centers_per_block, printing centers(words)/sec per config — the tuning
-    input for the bench's fused-resident path.
+    Times the center-major kernels on REAL skip-gram window batches over a
+    zipf corpus (bench-shaped: 1M vocab, dim 200, window 5, pool 64) — the
+    synthetic independent-draw workload this lab used first overstated the
+    resident win (duplicate/pad structure differs from real windows; lesson
+    recorded in docs/ARCHITECTURE.md). Shuffled batches feed grouped and
+    resident; block-ordered batches (batch_stream_blocks) feed grouped and
+    dedup. Prints words/sec per config — the tuning input for the bench's
+    fused-resident/fused-dedup paths.
 
         python tools/kernel_lab.py --resident [--quick]
     """
@@ -144,15 +148,19 @@ def resident_lab(argv=None):
     import jax
     import jax.numpy as jnp
 
+    from swiftsnails_tpu.data.sampler import (
+        batch_stream, batch_stream_blocks, skipgram_windows,
+    )
     from swiftsnails_tpu.ops import rowdma
     from swiftsnails_tpu.ops.fused_sgns import (
+        fused_sgns_dedup_step,
         fused_sgns_grouped_step,
         fused_sgns_resident_step,
     )
 
     interp = not rowdma.on_tpu()
     S = -(-args.dim // rowdma.ROW_LANES)
-    CW, PN, N = 10, 64, args.batch
+    W, PN, N = 5, 64, args.batch
     rng = np.random.default_rng(1)
     ranks = np.arange(1, args.vocab + 1, dtype=np.float64)
     w = 1.0 / ranks**1.05
@@ -161,45 +169,55 @@ def resident_lab(argv=None):
     def zipf(n):
         return np.searchsorted(cdf, rng.random(n)).astype(np.int32)
 
-    centers = jnp.asarray(zipf(N))
-    ctxs_np = zipf(N * CW).reshape(N, CW)
-    ctxs_np[rng.random((N, CW)) < 0.25] = -1
-    ctxs = jnp.asarray(ctxs_np)
+    ids = zipf(400_000)
+    g_c, g_x = skipgram_windows(ids, W, rng)
+    b_shuf = next(batch_stream(g_c, g_x, N, rng))
+    b_blk = next(batch_stream_blocks(g_c, g_x, N, rng, block=256))
     in_np = rng.random((args.vocab, S, 128), dtype=np.float32)
 
-    def timeit(fn, name, cpb, reps=12, **kw):
+    def timeit(fn, name, batch, reps=12, **kw):
+        cj = jnp.asarray(batch["centers"])
+        xj = jnp.asarray(batch["contexts"])
         a = jnp.asarray(in_np)
         b = jnp.zeros((args.vocab, S, 128), jnp.float32)
-        pool = jnp.asarray(zipf((N // cpb) * PN))
+        pool = jnp.asarray(zipf((N // 256) * PN))
         try:
-            a, b, loss = fn(a, b, centers, ctxs, pool, lr=0.025, lam=5 / PN,
-                            window=5, centers_per_block=cpb, pool_size=PN,
+            a, b, loss = fn(a, b, cj, xj, pool, lr=0.025, lam=5 / PN,
+                            window=W, centers_per_block=256, pool_size=PN,
                             interpret=interp, **kw)
             _ = float(loss)
             t0 = time.perf_counter()
             for _i in range(reps):
-                a, b, loss = fn(a, b, centers, ctxs, pool, lr=0.025,
-                                lam=5 / PN, window=5, centers_per_block=cpb,
+                a, b, loss = fn(a, b, cj, xj, pool, lr=0.025,
+                                lam=5 / PN, window=W, centers_per_block=256,
                                 pool_size=PN, interpret=interp, **kw)
             _ = float(loss)  # force the donated chain through the tunnel
             dt = (time.perf_counter() - t0) / reps
             print(f"{name}: {dt * 1e3:.2f} ms/substep  "
-                  f"{N / dt:,.0f} words/sec")
+                  f"{N / dt:,.0f} words/sec", flush=True)
             return N / dt
         except Exception as e:
-            print(f"{name} FAILED: {type(e).__name__}: {str(e)[:160]}")
+            print(f"{name} FAILED: {type(e).__name__}: {str(e)[:160]}",
+                  flush=True)
             return 0.0
 
-    cpbs = [256] if args.quick else [128, 256, 512]
-    hots = [2048] if args.quick else [1024, 2048, 4096]
     results = {}
-    for cpb in cpbs:
-        results[f"grouped cpb={cpb}"] = timeit(
-            fused_sgns_grouped_step, f"grouped cpb={cpb}", cpb)
-        for hot in hots:
-            results[f"resident cpb={cpb} hot={hot}"] = timeit(
-                fused_sgns_resident_step, f"resident cpb={cpb} hot={hot}",
-                cpb, hot_rows=hot)
+    results["dedup u_cap=384"] = timeit(
+        fused_sgns_dedup_step, "dedup u_cap=384 (block-ordered)", b_blk,
+        u_cap=384)
+    results["grouped"] = timeit(
+        fused_sgns_grouped_step, "grouped (shuffled)", b_shuf)
+    if not args.quick:
+        results["grouped block"] = timeit(
+            fused_sgns_grouped_step, "grouped (block-ordered)", b_blk)
+        for uc in (256, 512):
+            results[f"dedup u_cap={uc}"] = timeit(
+                fused_sgns_dedup_step, f"dedup u_cap={uc} (block-ordered)",
+                b_blk, u_cap=uc)
+        for hot in (512, 2048):
+            results[f"resident hot={hot}"] = timeit(
+                fused_sgns_resident_step, f"resident hot={hot} (shuffled)",
+                b_shuf, hot_rows=hot)
     best = max(results, key=results.get)
     print(f"best: {best} ({results[best]:,.0f} words/sec)")
 
