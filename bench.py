@@ -650,6 +650,7 @@ def measure_at_scale_structure(counts, path_overrides=None) -> None:
     }
     overrides.update(path_overrides or {})
     dedup_mode = overrides.get("dedup") == "1"
+    cpb = int(overrides.get("centers_per_block", 256) or 256)
     vocab = Vocab([f"w{i}" for i in range(VOCAB)], np.maximum(counts, 1))
     trainer = Word2VecTrainer(
         Config(overrides), mesh=None, corpus_ids=np.zeros(2, np.int32),
@@ -669,7 +670,7 @@ def measure_at_scale_structure(counts, path_overrides=None) -> None:
     from swiftsnails_tpu.data.sampler import batch_stream_blocks
 
     stream = (
-        batch_stream_blocks(g_c, g_x, macro, srng, block=256)
+        batch_stream_blocks(g_c, g_x, macro, srng, block=cpb)
         if dedup_mode
         else batch_stream(g_c, g_x, macro, srng)
     )
@@ -710,6 +711,14 @@ def measure_at_scale_structure(counts, path_overrides=None) -> None:
         "planted_pairs": int(len(pair_a)),
         "trained_words": int(trained_words),
         "train_seconds": round(time.monotonic() - t0, 1),
+        # which config actually trained (the headline path's when grouped;
+        # the plain grouped kernel otherwise — never claim more than ran)
+        "trained_overrides": {
+            k: overrides[k]
+            for k in ("fused", "grouped", "resident", "dedup", "hot_rows",
+                      "u_cap", "centers_per_block")
+            if k in overrides
+        },
     }
     print(f"bench: at-scale structure: partner top-1 {top1.mean():.3f} "
           f"{by_band} after {trained_words:,} words", file=sys.stderr)
@@ -901,10 +910,14 @@ def main():
     if BENCH_DEADLINE_S - (time.monotonic() - _T0) >= AT_SCALE_MIN_BUDGET_S:
         try:
             best_ov = _state["best_overrides"]
-            measure_at_scale_structure(
-                counts,
-                best_ov if best_ov and best_ov.get("grouped") == "1" else None,
-            )
+            if best_ov and best_ov.get("grouped") != "1":
+                _state["errors"].append(
+                    f"at-scale stage: headline path {_state['best_path']} has "
+                    "no window schema; trained the grouped kernel instead "
+                    "(see at_scale.trained_overrides)"
+                )
+                best_ov = None
+            measure_at_scale_structure(counts, best_ov)
         except Exception as e:
             _state["errors"].append(f"at-scale structure stage failed: {e}")
     else:
