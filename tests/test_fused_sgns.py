@@ -464,6 +464,44 @@ def test_dedup_trainer_trains_toy_corpus():
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
+def test_dedup_trainer_native_window_producer():
+    """dedup: 1 with the native C window producer (the production path):
+    batches carry the window schema and train to finite losses."""
+    from swiftsnails_tpu.data import native
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.utils.config import Config
+
+    if not native.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(0)
+    vocab_size = 48
+    counts = np.sort(rng.integers(1, 50, vocab_size))[::-1].astype(np.int64)
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)], counts)
+    corpus = rng.integers(0, vocab_size, 1500).astype(np.int32)
+    cfg = Config({
+        "dim": "16", "window": "2", "negatives": "2", "learning_rate": "0.1",
+        "batch_size": "64", "subsample": "0", "num_iters": "4",
+        "pool_size": "8", "pool_block": "16", "packed": "1", "fused": "1",
+        "grouped": "1", "dedup": "1", "u_cap": "32",
+        "centers_per_block": "16", "use_native": "1",
+    })
+    tr = Word2VecTrainer(cfg, mesh=None, corpus_ids=corpus, vocab=vocab)
+    assert tr.dedup
+    state = tr.init_state()
+    step = jax.jit(tr.train_step, donate_argnums=(0,))
+    n = 0
+    for batch in tr.batches():
+        assert batch["contexts"].ndim == 2
+        state, m = step(state, {k: jnp.asarray(v) for k, v in batch.items()},
+                        jax.random.fold_in(jax.random.PRNGKey(0), n))
+        assert np.isfinite(float(m["loss"]))
+        n += 1
+        if n >= 6:
+            break
+    assert n >= 4
+
+
 def test_batch_stream_blocks_non_divisible_batch():
     """batch_size not divisible by block: batches must still be EXACTLY
     batch_size (train_step reshapes by it) — block shrinks to a divisor."""
