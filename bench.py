@@ -748,13 +748,23 @@ def measure_input_pipeline(ids, pairs_per_token: float) -> None:
 
     rng = np.random.default_rng(11)
     t0 = time.perf_counter()
+    n_words = 0
     if native.available():
+        # the PRODUCTION grouped pipeline: native window fill + native
+        # block-ordered batch assembly (the dedup headline path's producer,
+        # Word2VecTrainer.batches)
         g_c, g_x = native.skipgram_windows(ids, WINDOW, seed=11)
+        wp = native.WindowPrefetcher(
+            g_c, g_x, min(BATCH, 8192) * STEPS_PER_CALL, block=256,
+            capacity=8, seed=11,
+        )
+        for w in wp:
+            n_words += w["centers"].size
+        wp.close()
     else:
         g_c, g_x = skipgram_windows(ids, WINDOW, rng)
-    n_words = 0
-    for w in batch_stream(g_c, g_x, min(BATCH, 8192) * STEPS_PER_CALL, rng):
-        n_words += w["centers"].size
+        for w in batch_stream(g_c, g_x, min(BATCH, 8192) * STEPS_PER_CALL, rng):
+            n_words += w["centers"].size
     dt = time.perf_counter() - t0
     _state["input_words_per_sec_grouped"] = n_words / dt
 

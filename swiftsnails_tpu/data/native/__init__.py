@@ -115,6 +115,14 @@ def _bind(lib, c):
         lib.ssn_prefetch_next.restype = c.c_int
         lib.ssn_prefetch_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
         lib.ssn_prefetch_close.argtypes = [c.c_void_p]
+        lib.ssn_win_prefetch_open.restype = c.c_void_p
+        lib.ssn_win_prefetch_open.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int, c.c_int64, c.c_int64,
+            c.c_int, c.c_int, c.c_int, c.c_uint64,
+        ]
+        lib.ssn_win_prefetch_next.restype = c.c_int
+        lib.ssn_win_prefetch_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+        lib.ssn_win_prefetch_close.argtypes = [c.c_void_p]
         lib.ssn_vocab_build_stream.restype = c.c_void_p
         lib.ssn_vocab_build_stream.argtypes = [c.c_char_p, c.c_int, c.c_int]
         lib.ssn_stream_open.restype = c.c_void_p
@@ -440,6 +448,68 @@ class PairPrefetcher:
     def close(self):
         if self._h:
             self._lib.ssn_prefetch_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class WindowPrefetcher:
+    """Center-major window-batch producer (grouped/dedup kernel schema).
+
+    C++ worker threads shuffle BLOCKS of ``block`` consecutive windows
+    (``block=1`` = plain row shuffle) and assemble
+    ``{"centers": [B], "contexts": [B, cw]}`` batches behind a bounded
+    order-preserving ticket ring — the batch sequence is deterministic in
+    ``seed``/``epochs`` regardless of worker count. This replaces the
+    Python ``batch_stream``/``batch_stream_blocks`` loop in the hot path
+    (same schema, native assembly).
+    """
+
+    def __init__(
+        self,
+        centers: np.ndarray,
+        contexts: np.ndarray,
+        batch_size: int,
+        block: int = 1,
+        epochs: int = 1,
+        capacity: int = 8,
+        workers: int = 0,
+        seed: int = 0,
+    ):
+        lib = _require()
+        self._lib = lib
+        self.batch_size = batch_size
+        c = np.ascontiguousarray(centers, dtype=np.int32)
+        x = np.ascontiguousarray(contexts, dtype=np.int32)
+        if x.ndim != 2 or x.shape[0] != c.size:
+            raise ValueError(f"contexts must be [n, cw], got {x.shape}")
+        self.cw = x.shape[1]
+        self._h = lib.ssn_win_prefetch_open(
+            _ptr(c), _ptr(x), c.size, self.cw, batch_size, block, epochs,
+            capacity, workers, seed,
+        )
+        if not self._h:
+            raise ValueError(
+                "bad window-prefetcher arguments (empty data, batch > n, or "
+                "batch not a multiple of block)"
+            )
+
+    def __iter__(self):
+        while True:
+            centers = np.empty(self.batch_size, dtype=np.int32)
+            contexts = np.empty((self.batch_size, self.cw), dtype=np.int32)
+            ok = self._lib.ssn_win_prefetch_next(self._h, _ptr(centers), _ptr(contexts))
+            if not ok:
+                return
+            yield {"centers": centers, "contexts": contexts}
+
+    def close(self):
+        if self._h:
+            self._lib.ssn_win_prefetch_close(self._h)
             self._h = None
 
     def __del__(self):

@@ -449,74 +449,182 @@ static inline uint64_t splitmix64(uint64_t& s) {
   return z ^ (z >> 31);
 }
 
+// Stateless splitmix64 draw at stream position i: identical output to
+// advancing a splitmix64 stream i+1 times, but random-access — every
+// position's draw is computable independently, so pair/window generation
+// parallelizes (and shards of a corpus can be processed in any order)
+// without changing the generated pair set for a given seed.
+static inline uint64_t splitmix64_at(uint64_t seed, int64_t i) {
+  uint64_t z = seed + 0x9e3779b97f4a7c15ULL * (uint64_t)(i + 1);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// b ~ U(1, window) for center position i (word2vec dynamic window).
+static inline int draw_b(uint64_t seed, int64_t i, int window, int dynamic) {
+  if (!dynamic) return window;
+  return (int)(splitmix64_at(seed ^ 0xdeadbeefcafef00dULL, i) %
+               (uint64_t)window) + 1;
+}
+
+// Worker count for the parallel producers: hardware cores, env-overridable.
+// On a 1-core host everything stays sequential (threads would only add
+// contention); on real TPU-host CPUs (dozens of cores) the generation and
+// batch-assembly fan out.
+static int default_workers() {
+  const char* env = std::getenv("SSN_NATIVE_THREADS");
+  if (env && *env) {
+    int v = std::atoi(env);
+    if (v > 0) return v;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  return hw > 0 ? (int)(hw > 16 ? 16 : hw) : 1;
+}
+
+// Run fn(shard_lo, shard_hi) over [0, n) in contiguous shards across the
+// worker pool; sequential when one worker (or tiny n).
+template <typename F>
+static void parallel_spans(int64_t n, int nworkers, F fn) {
+  if (nworkers <= 1 || n < (1 << 16)) {
+    fn((int64_t)0, n);
+    return;
+  }
+  int64_t shard = (n + nworkers - 1) / nworkers;
+  std::vector<std::thread> ts;
+  for (int w = 0; w < nworkers; ++w) {
+    int64_t lo = w * shard, hi = std::min(n, lo + shard);
+    if (lo >= hi) break;
+    ts.emplace_back([=] { fn(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
 // Dynamic-window pair generation (word2vec b ~ U(1, window)).
-// Returns npairs; if out arrays are null, only counts.
+// Returns npairs; if out arrays are null, only counts. Per-position draws
+// (splitmix64_at) make the pair set independent of sharding, so the count
+// and fill passes parallelize over contiguous spans.
 extern "C" int64_t ssn_skipgram_pairs(const int32_t* ids, int64_t n, int window,
                            uint64_t seed, int dynamic, int32_t* centers,
                            int32_t* contexts, int64_t cap) {
-  uint64_t s = seed ^ 0xdeadbeefcafef00dULL;
-  int64_t k = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    int b = dynamic ? (int)(splitmix64(s) % (uint64_t)window) + 1 : window;
-    int64_t lo = i - b < 0 ? 0 : i - b;
-    int64_t hi = i + b >= n ? n - 1 : i + b;
-    for (int64_t j = lo; j <= hi; ++j) {
-      if (j == i) continue;
-      if (centers) {
-        if (k >= cap) return -k;  // undersized buffer
-        centers[k] = ids[i];
-        contexts[k] = ids[j];
-      }
-      ++k;
+  if (n <= 0) return 0;  // empty chunk (e.g. fully subsampled away)
+  int nw = default_workers();
+  // pass 1: pairs per span (exact prefix offsets for the parallel fill)
+  int64_t shard = nw <= 1 ? n : (n + nw - 1) / nw;
+  if (shard <= 0) shard = 1;
+  int nshards = (int)((n + shard - 1) / shard);
+  std::vector<int64_t> span_pairs((size_t)std::max(nshards, 1), 0);
+  parallel_spans(n, nw, [&](int64_t lo, int64_t hi) {
+    int64_t k = 0;
+    for (int64_t i = lo; i < hi; ++i) {
+      int b = draw_b(seed, i, window, dynamic);
+      int64_t lo_j = i - b < 0 ? 0 : i - b;
+      int64_t hi_j = i + b >= n ? n - 1 : i + b;
+      k += (hi_j - lo_j);  // minus the center itself: (hi-lo+1) - 1
     }
-  }
-  return k;
+    span_pairs[(size_t)(lo / shard)] = k;
+  });
+  int64_t total = 0;
+  for (int64_t c : span_pairs) total += c;
+  if (!centers) return total;
+  if (total > cap) return -total;  // undersized buffer
+  std::vector<int64_t> offs((size_t)nshards, 0);
+  for (int s = 1; s < nshards; ++s)
+    offs[(size_t)s] = offs[(size_t)s - 1] + span_pairs[(size_t)s - 1];
+  parallel_spans(n, nw, [&](int64_t lo, int64_t hi) {
+    int64_t k = offs[(size_t)(lo / shard)];
+    for (int64_t i = lo; i < hi; ++i) {
+      int b = draw_b(seed, i, window, dynamic);
+      int64_t lo_j = i - b < 0 ? 0 : i - b;
+      int64_t hi_j = i + b >= n ? n - 1 : i + b;
+      int32_t ci = ids[i];
+      for (int64_t j = lo_j; j <= hi_j; ++j) {
+        if (j == i) continue;
+        centers[k] = ci;
+        contexts[k] = ids[j];
+        ++k;
+      }
+    }
+  });
+  return total;
 }
 
 // Center-major windows: contexts[i, slot] for slot offsets [-w..-1, 1..w],
 // -1 where out of range or beyond the drawn b ~ U(1, window). SAME b draw
-// sequence as ssn_skipgram_pairs for a given seed, so the flat and grouped
-// schemas generate the identical pair set (the invariant the Python twins
-// keep via _dynamic_window_valid).
+// (draw_b at position i) as ssn_skipgram_pairs for a given seed, so the
+// flat and grouped schemas generate the identical pair set (the invariant
+// the Python twins keep via _dynamic_window_valid). Parallel over spans.
 extern "C" int64_t ssn_skipgram_windows(const int32_t* ids, int64_t n,
                                         int window, uint64_t seed, int dynamic,
                                         int32_t* ctxs /* [n, 2*window] */) {
-  uint64_t s = seed ^ 0xdeadbeefcafef00dULL;
   const int cw = 2 * window;
-  for (int64_t i = 0; i < n; ++i) {
-    int b = dynamic ? (int)(splitmix64(s) % (uint64_t)window) + 1 : window;
-    int32_t* row = ctxs + i * cw;
-    for (int o = -window; o <= window; ++o) {
-      if (o == 0) continue;
-      int slot = o < 0 ? o + window : o + window - 1;
-      int64_t j = i + o;
-      int ab = o < 0 ? -o : o;
-      row[slot] = (j >= 0 && j < n && ab <= b) ? ids[j] : -1;
+  parallel_spans(n, default_workers(), [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      int b = draw_b(seed, i, window, dynamic);
+      int32_t* row = ctxs + i * cw;
+      for (int o = -window; o <= window; ++o) {
+        if (o == 0) continue;
+        int slot = o < 0 ? o + window : o + window - 1;
+        int64_t j = i + o;
+        int ab = o < 0 ? -o : o;
+        row[slot] = (j >= 0 && j < n && ab <= b) ? ids[j] : -1;
+      }
     }
-  }
+  });
   return n;
 }
 
 // Frequent-word subsampling: keep w with p = sqrt(t/f) + t/f (word2vec).
-// Writes kept ids to out, returns kept count.
+// Writes kept ids to out, returns kept count. The keep draw is per-position
+// (splitmix64_at), so the kept set is independent of sharding: count +
+// compact passes parallelize over spans with exact prefix offsets.
 extern "C" int64_t ssn_subsample(const int32_t* ids, int64_t n, const int64_t* counts,
                       int64_t vocab, double total, double threshold,
                       uint64_t seed, int32_t* out) {
+  if (n <= 0) return 0;  // empty chunk
   if (threshold <= 0) {
     std::memcpy(out, ids, (size_t)n * sizeof(int32_t));
     return n;
   }
-  uint64_t s = seed ^ 0x12345678abcdefULL;
-  int64_t k = 0;
+  const uint64_t s = seed ^ 0x12345678abcdefULL;
   const double inv = 1.0 / 9007199254740992.0;  // 2^-53
-  for (int64_t i = 0; i < n; ++i) {
+  // precompute per-id keep probability once (vocab << n): the sqrt/div per
+  // TOKEN was the old loop's cost; per-id it amortizes across the corpus
+  std::vector<float> keep_p((size_t)vocab);
+  parallel_spans(vocab, default_workers(), [&](int64_t lo, int64_t hi) {
+    for (int64_t v = lo; v < hi; ++v) {
+      double f = (double)counts[v] / total;
+      keep_p[(size_t)v] =
+          (float)std::min(1.0, std::sqrt(threshold / f) + threshold / f);
+    }
+  });
+  int nw = default_workers();
+  int64_t shard = nw <= 1 ? n : (n + nw - 1) / nw;
+  if (shard <= 0) shard = 1;
+  int nshards = (int)((n + shard - 1) / shard);
+  std::vector<int64_t> span_kept((size_t)std::max(nshards, 1), 0);
+  auto kept_at = [&](int64_t i) -> bool {
     int32_t id = ids[i];
-    double f = (id >= 0 && id < vocab ? (double)counts[id] : 1.0) / total;
-    double keep = std::min(1.0, std::sqrt(threshold / f) + threshold / f);
-    double u = (double)(splitmix64(s) >> 11) * inv;
-    if (u < keep) out[k++] = id;
-  }
-  return k;
+    float keep = (id >= 0 && id < vocab) ? keep_p[(size_t)id] : 1.0f;
+    double u = (double)(splitmix64_at(s, i) >> 11) * inv;
+    return u < keep;
+  };
+  parallel_spans(n, nw, [&](int64_t lo, int64_t hi) {
+    int64_t k = 0;
+    for (int64_t i = lo; i < hi; ++i) k += kept_at(i);
+    span_kept[(size_t)(lo / shard)] = k;
+  });
+  std::vector<int64_t> offs((size_t)nshards, 0);
+  for (int sI = 1; sI < nshards; ++sI)
+    offs[(size_t)sI] = offs[(size_t)sI - 1] + span_kept[(size_t)sI - 1];
+  parallel_spans(n, nw, [&](int64_t lo, int64_t hi) {
+    int64_t k = offs[(size_t)(lo / shard)];
+    for (int64_t i = lo; i < hi; ++i)
+      if (kept_at(i)) out[k++] = ids[i];
+  });
+  int64_t totalk = 0;
+  for (int64_t c : span_kept) totalk += c;
+  return totalk;
 }
 
 // ------------------------------------------------------------------- ctr ---
@@ -677,10 +785,30 @@ extern "C" double ssn_sgns_train(float* syn0, float* syn1, int dim,
 
 // -------------------------------------------------------------- prefetch ---
 
+// Fisher-Yates with splitmix64 draws + Lemire multiply-shift bounded
+// mapping: ~3x std::shuffle (which pays a division per element in
+// uniform_int_distribution). Bias is O(2^-64) per draw — irrelevant for
+// batch ordering.
+template <typename T>
+static void fy_shuffle(T* a, int64_t n, uint64_t seed) {
+  uint64_t s = seed ^ 0x5bf0363546536b1dULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t r = splitmix64(s);
+    int64_t j = (int64_t)(((unsigned __int128)r * (uint64_t)(i + 1)) >> 64);
+    T t = a[i];
+    a[i] = a[j];
+    a[j] = t;
+  }
+}
+
 // Bounded-queue shuffled-batch producer (queue_with_capacity parity:
 // capacity-bounded, blocking push/pop, explicit end_input poison).
 struct Prefetcher {
-  std::vector<int32_t> centers, contexts;
+  // pairs stored INTERLEAVED [c0,x0,c1,x1,...]: the shuffled gather is the
+  // producer's cost and is cache-miss-bound — one 8-byte access per pair
+  // instead of two 4-byte accesses into arrays ~n*4 bytes apart
+  std::vector<int32_t> cx;
+  int64_t n = 0;
   int64_t batch;
   int epochs;
   uint64_t seed;
@@ -693,20 +821,21 @@ struct Prefetcher {
   std::thread worker;
 
   void produce() {
-    int64_t n = (int64_t)centers.size();
     int64_t nb = n / batch;
-    std::vector<int64_t> order((size_t)n);
-    std::mt19937_64 rng(seed);
+    // 32-bit order indices: the Fisher-Yates pass and the gather's index
+    // reads are cache-miss-bound, so halving the index footprint matters
+    // (pair counts < 2^31 by the open() guard)
+    std::vector<uint32_t> order((size_t)n);
+    const uint32_t* ord = order.data();
     for (int e = 0; e < epochs; ++e) {
-      for (int64_t i = 0; i < n; ++i) order[(size_t)i] = i;
-      std::shuffle(order.begin(), order.end(), rng);
+      for (int64_t i = 0; i < n; ++i) order[(size_t)i] = (uint32_t)i;
+      fy_shuffle(order.data(), n, seed + (uint64_t)e);
       for (int64_t bi = 0; bi < nb; ++bi) {
         std::vector<int32_t> item((size_t)(2 * batch));
-        for (int64_t j = 0; j < batch; ++j) {
-          int64_t src = order[(size_t)(bi * batch + j)];
-          item[(size_t)(2 * j)] = centers[(size_t)src];
-          item[(size_t)(2 * j + 1)] = contexts[(size_t)src];
-        }
+        int64_t* dst = (int64_t*)item.data();
+        const int64_t* src64 = (const int64_t*)cx.data();
+        for (int64_t j = 0; j < batch; ++j)
+          dst[j] = src64[ord[bi * batch + j]];  // whole pair, one access
         std::unique_lock<std::mutex> lk(mu);
         cv_push.wait(lk, [&] { return queue.size() < capacity || closed; });
         if (closed) return;
@@ -724,9 +853,14 @@ extern "C" void* ssn_prefetch_open(const int32_t* centers, const int32_t* contex
                         int64_t n, int64_t batch, int epochs, int capacity,
                         uint64_t seed) {
   if (n <= 0 || batch <= 0 || batch > n) return nullptr;
+  if (n > (int64_t)1 << 31) return nullptr;  // 32-bit shuffle indices
   Prefetcher* p = new Prefetcher();
-  p->centers.assign(centers, centers + n);
-  p->contexts.assign(contexts, contexts + n);
+  p->n = n;
+  p->cx.resize((size_t)(2 * n));
+  for (int64_t i = 0; i < n; ++i) {
+    p->cx[(size_t)(2 * i)] = centers[i];
+    p->cx[(size_t)(2 * i + 1)] = contexts[i];
+  }
   p->batch = batch;
   p->epochs = epochs;
   p->seed = seed;
@@ -763,6 +897,142 @@ extern "C" void ssn_prefetch_close(void* h) {
     p->cv_pop.notify_all();
   }
   if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+// ----------------------------------------------- window batch producer ---
+//
+// Center-major batch producer for the grouped/dedup kernels: shuffles
+// BLOCKS of `block` consecutive windows (block = 1 -> plain row shuffle)
+// and assembles {centers [batch], contexts [batch, cw]} items on a pool of
+// worker threads behind a bounded ORDER-PRESERVING ticket ring, so the
+// batch sequence is deterministic in (seed, epochs) regardless of worker
+// count. Block mode copies whole contiguous spans (memcpy per block) — the
+// assembly cost the Python batch_stream paid per-row in numpy. Bounded
+// queue + poison-free end: queue_with_capacity parity
+// (src/utils/queue.h:100-108), like the pair Prefetcher above.
+struct WinPrefetcher {
+  std::vector<int32_t> c;   // [n]
+  std::vector<int32_t> x;   // [n, cw] flattened
+  int cw = 0;
+  int64_t batch = 0, block = 1;
+  int64_t nblocks = 0, blocks_per_batch = 0, batches_per_epoch = 0;
+  int64_t total_batches = 0;
+  std::vector<int64_t> order;  // [epochs * nblocks] block schedule
+  size_t capacity = 4;
+
+  std::vector<std::vector<int32_t>> slots;  // ticket ring
+  std::vector<int64_t> slot_ticket;         // -1 = empty
+  std::atomic<int64_t> next_ticket{0};
+  int64_t consumed = 0;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  bool closed = false;
+  std::vector<std::thread> workers;
+
+  void work() {
+    for (;;) {
+      int64_t t = next_ticket.fetch_add(1);
+      if (t >= total_batches) break;
+      std::vector<int32_t> item((size_t)(batch * (1 + cw)));
+      int32_t* co = item.data();
+      int32_t* xo = item.data() + batch;
+      const int64_t* ord = order.data() +
+                           (t / batches_per_epoch) * nblocks +
+                           (t % batches_per_epoch) * blocks_per_batch;
+      for (int64_t bi = 0; bi < blocks_per_batch; ++bi) {
+        int64_t src = ord[bi] * block;
+        std::memcpy(co + bi * block, c.data() + src,
+                    (size_t)block * sizeof(int32_t));
+        std::memcpy(xo + bi * block * cw, x.data() + src * cw,
+                    (size_t)(block * cw) * sizeof(int32_t));
+      }
+      std::unique_lock<std::mutex> lk(mu);
+      cv_free.wait(lk, [&] {
+        return closed || t - consumed < (int64_t)capacity;
+      });
+      if (closed) return;
+      size_t s = (size_t)(t % (int64_t)capacity);
+      slots[s] = std::move(item);
+      slot_ticket[s] = t;
+      cv_ready.notify_all();
+    }
+  }
+};
+
+extern "C" void* ssn_win_prefetch_open(const int32_t* centers,
+                                       const int32_t* ctxs, int64_t n, int cw,
+                                       int64_t batch, int64_t block, int epochs,
+                                       int capacity, int nworkers,
+                                       uint64_t seed) {
+  if (n <= 0 || cw <= 0 || batch <= 0 || batch > n || epochs <= 0)
+    return nullptr;
+  if (block <= 0) block = 1;
+  if (batch % block) return nullptr;  // kernel blocks must tile batches
+  WinPrefetcher* p = new WinPrefetcher();
+  p->c.assign(centers, centers + n);
+  p->x.assign(ctxs, ctxs + n * cw);
+  p->cw = cw;
+  p->batch = batch;
+  p->block = block;
+  p->nblocks = n / block;
+  p->blocks_per_batch = batch / block;
+  p->batches_per_epoch = p->nblocks / p->blocks_per_batch;
+  p->total_batches = (int64_t)epochs * p->batches_per_epoch;
+  if (p->total_batches <= 0) {
+    delete p;
+    return nullptr;
+  }
+  p->capacity = (size_t)(capacity > 0 ? capacity : 4);
+  p->slots.resize(p->capacity);
+  p->slot_ticket.assign(p->capacity, -1);
+  p->order.resize((size_t)((int64_t)epochs * p->nblocks));
+  for (int e = 0; e < epochs; ++e) {
+    int64_t* o = p->order.data() + (int64_t)e * p->nblocks;
+    for (int64_t i = 0; i < p->nblocks; ++i) o[i] = i;
+    fy_shuffle(o, p->nblocks, seed + (uint64_t)e);
+  }
+  int nw = nworkers > 0 ? nworkers : default_workers();
+  if ((int64_t)nw > p->total_batches) nw = (int)p->total_batches;
+  for (int w = 0; w < nw; ++w)
+    p->workers.emplace_back([p] { p->work(); });
+  return p;
+}
+
+// 1 = batch written; 0 = end of input (poison-free shutdown semantics).
+extern "C" int ssn_win_prefetch_next(void* h, int32_t* centers_out,
+                                     int32_t* ctxs_out) {
+  WinPrefetcher* p = (WinPrefetcher*)h;
+  std::vector<int32_t> item;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->consumed >= p->total_batches) return 0;
+    size_t s = (size_t)(p->consumed % (int64_t)p->capacity);
+    p->cv_ready.wait(lk, [&] {
+      return p->closed || p->slot_ticket[s] == p->consumed;
+    });
+    if (p->closed) return 0;
+    item = std::move(p->slots[s]);
+    p->slot_ticket[s] = -1;
+    ++p->consumed;
+    p->cv_free.notify_all();
+  }
+  std::memcpy(centers_out, item.data(), (size_t)p->batch * sizeof(int32_t));
+  std::memcpy(ctxs_out, item.data() + p->batch,
+              (size_t)(p->batch * p->cw) * sizeof(int32_t));
+  return 1;
+}
+
+extern "C" void ssn_win_prefetch_close(void* h) {
+  WinPrefetcher* p = (WinPrefetcher*)h;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->closed = true;
+    p->cv_ready.notify_all();
+    p->cv_free.notify_all();
+  }
+  for (auto& w : p->workers)
+    if (w.joinable()) w.join();
   delete p;
 }
 

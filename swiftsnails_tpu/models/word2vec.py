@@ -380,15 +380,39 @@ class Word2VecTrainer(Trainer):
                         g_c, g_x = skipgram_windows(chunk, self.window, rng)
                     macro = self.batch_size * self.steps_per_call
                     n_batches = max(len(g_c) // macro, 1)
-                    stream = (
-                        batch_stream_blocks(g_c, g_x, macro, rng,
-                                            block=self.centers_per_block)
-                        if self.dedup
-                        else batch_stream(g_c, g_x, macro, rng)
+                    # Block-order only where a kernel consumes it: the mesh
+                    # plane does no per-block dedup, so block shuffling there
+                    # would trade SGD mixing for nothing. The sampler block
+                    # must equal the kernel's EFFECTIVE centers_per_block
+                    # (largest divisor of the per-substep batch — the same
+                    # shrink _substep_grouped applies), so kernel blocks never
+                    # straddle shuffled sampler blocks; batch_size divides the
+                    # macro batch, so the divisor chain holds end to end.
+                    block = (
+                        self._effective_pc()
+                        if self.dedup and self.mesh is None
+                        else 1
                     )
-                    for bi, b in enumerate(stream):
-                        p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
-                        yield {**b, "progress": np.float32(min(p, 1.0))}
+                    if use_native and len(g_c) >= macro:
+                        # native assembly: C++ worker threads gather batches
+                        # behind a bounded ticket ring (block mode copies
+                        # whole contiguous window spans)
+                        stream = native.WindowPrefetcher(
+                            g_c, g_x, macro, block=block, epochs=1,
+                            capacity=4, seed=seed,
+                        )
+                    elif block > 1:
+                        stream = batch_stream_blocks(g_c, g_x, macro, rng,
+                                                     block=block)
+                    else:
+                        stream = batch_stream(g_c, g_x, macro, rng)
+                    try:
+                        for bi, b in enumerate(stream):
+                            p = (chunk_base + (bi / n_batches) * chunk_len) / total_tokens
+                            yield {**b, "progress": np.float32(min(p, 1.0))}
+                    finally:
+                        if hasattr(stream, "close"):
+                            stream.close()
                     continue
                 if use_native:
                     centers, contexts = native.skipgram_pairs(
@@ -420,6 +444,18 @@ class Word2VecTrainer(Trainer):
                         stream.close()
 
     # -- step --------------------------------------------------------------
+
+    def _effective_pc(self, n: int | None = None) -> int:
+        """The grouped kernels' EFFECTIVE centers-per-block: the largest
+        divisor of the per-substep batch ``n`` (default ``batch_size``) not
+        exceeding ``centers_per_block`` — the same trace-time shrink the
+        grouped substeps apply, shared so the block-ordered sampler and the
+        kernel can never disagree on block granularity."""
+        n = self.batch_size if n is None else n
+        pc = min(self.centers_per_block, n)
+        while n % pc:
+            pc -= 1
+        return pc
 
     def _substep_dense(self, state: W2VState, centers, contexts, rng, lr):
         """Reference-faithful substep: per-pair negatives, 2-D tables."""
@@ -533,9 +569,7 @@ class Word2VecTrainer(Trainer):
         n = centers.shape[0]
         # largest divisor of n not exceeding centers_per_block (static under
         # jit), so small test batches work unchanged
-        pc = min(self.centers_per_block, n)
-        while n % pc:
-            pc -= 1
+        pc = self._effective_pc(n)
         nb = n // pc
         pn = self.pool_size
         pools = alias_sample(self.neg_alias, rng, (nb, pn))
@@ -594,9 +628,7 @@ class Word2VecTrainer(Trainer):
         """
         n = centers.shape[0]
         cw = ctxs.shape[1]
-        pc = min(self.centers_per_block, n)
-        while n % pc:
-            pc -= 1
+        pc = self._effective_pc(n)
         nb = n // pc
         pn = self.pool_size
         lam = self.negatives / pn

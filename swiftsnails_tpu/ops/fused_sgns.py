@@ -691,6 +691,31 @@ def _check_resident_vmem(hot_n, pc, cap, pn, row_shape, dtype):
         )
 
 
+def _check_dedup_vmem(u_cap, pc, cap, pn, row_shape, dtype):
+    """Dedup-shaped twin of :func:`_check_resident_vmem`: fail fast with a
+    clear message instead of an opaque Mosaic OOM when ``u_cap`` /
+    ``centers_per_block`` push the scratch + f32 working set past the
+    scoped-VMEM grant."""
+    import math
+
+    row_bytes = math.prod(row_shape) * jnp.dtype(dtype).itemsize
+    dp_f32 = math.prod(row_shape) * 4
+    # double-buffered v/u/p/u_uniq scratch
+    scratch = 2 * (pc + cap + pn + u_cap) * row_bytes
+    # f32 working set: merged slot values + grads (cap/pc/pn), twice over
+    # for where-selects and update temporaries, plus the one-hot broadcast
+    # accumulator and the unique-row update temporaries
+    working = 4 * dp_f32 * (cap + pc + pn) + 2 * dp_f32 * u_cap
+    need = scratch + working
+    if need > _RESIDENT_VMEM_BYTES:
+        raise ValueError(
+            f"dedup kernel VMEM estimate {need / 2**20:.1f} MiB exceeds "
+            f"the {_RESIDENT_VMEM_BYTES / 2**20:.0f} MiB budget "
+            f"(u_cap={u_cap}, centers_per_block={pc}, ctx slots={cap}, "
+            f"pool={pn}); lower u_cap or centers_per_block"
+        )
+
+
 def _cold_compact(rows, is_cold, slot_bits=20):
     """Compact cold entries to the front of each block's copy list.
 
@@ -1084,6 +1109,7 @@ def fused_sgns_dedup_step(
         raise ValueError("table capacity exceeds 2^30 (row-id flag bit)")
     if in_table.shape[1:] != out_table.shape[1:] or in_table.dtype != out_table.dtype:
         raise ValueError("in/out tables must share row shape and dtype")
+    _check_dedup_vmem(u_cap, pc, cap, pn, in_table.shape[1:], in_table.dtype)
 
     big = jnp.int32(2**31 - 1)
     flat = (

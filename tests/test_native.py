@@ -137,6 +137,67 @@ def test_prefetcher_early_close_no_hang():
     pf.close()  # producer blocked on full queue must exit cleanly
 
 
+def test_empty_inputs_no_crash():
+    """Empty chunks (e.g. fully subsampled away) must return empty, not
+    crash — regression: the parallel two-pass rewrites sized their offset
+    tables by shard count and dereferenced them even at n=0."""
+    c, x = native.skipgram_pairs(np.empty(0, np.int32), 5)
+    assert c.size == 0 and x.size == 0
+    counts = np.array([10, 10], dtype=np.int64)
+    kept = native.subsample(np.empty(0, np.int32), counts, 1e-3)
+    assert kept.size == 0
+
+
+def test_window_prefetcher_delivers_aligned_blocks():
+    """Every window delivered exactly once per epoch; context rows stay
+    with their centers; block mode keeps blocks corpus-contiguous."""
+    n, cw, bs, block = 10_240, 6, 1_024, 256
+    g_c = np.arange(n, dtype=np.int32)
+    g_x = (g_c[:, None] * 10 + np.arange(cw, dtype=np.int32)[None, :]).astype(
+        np.int32)
+    wp = native.WindowPrefetcher(g_c, g_x, bs, block=block, seed=3)
+    seen = []
+    for b in wp:
+        c, x = b["centers"], b["contexts"]
+        assert c.shape == (bs,) and x.shape == (bs, cw)
+        np.testing.assert_array_equal(x, c[:, None] * 10 + np.arange(cw))
+        for lo in range(0, bs, block):
+            blk = c[lo:lo + block]
+            np.testing.assert_array_equal(
+                blk, np.arange(blk[0], blk[0] + block))
+        seen.append(c)
+    wp.close()
+    allc = np.sort(np.concatenate(seen))
+    np.testing.assert_array_equal(allc, g_c)  # full permutation, no dupes
+
+
+def test_window_prefetcher_deterministic_across_workers():
+    n, cw = 8_192, 4
+    g_c = np.arange(n, dtype=np.int32)
+    g_x = np.repeat(g_c[:, None], cw, axis=1)
+
+    def run(workers):
+        wp = native.WindowPrefetcher(
+            g_c, g_x, 1_024, block=128, seed=7, workers=workers)
+        out = [b["centers"].copy() for b in wp]
+        wp.close()
+        return out
+
+    for a, b in zip(run(1), run(4)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_window_prefetcher_early_close_no_hang():
+    n = 65_536
+    g_c = np.arange(n, dtype=np.int32)
+    g_x = np.repeat(g_c[:, None], 4, axis=1)
+    wp = native.WindowPrefetcher(g_c, g_x, 512, block=1, epochs=50,
+                                 capacity=2, workers=2)
+    it = iter(wp)
+    next(it)
+    wp.close()  # workers blocked on the full ticket ring must exit cleanly
+
+
 def test_sgns_train_learns_structure():
     """The C baseline loop must actually train, not just loop fast.
 
