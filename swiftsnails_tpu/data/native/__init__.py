@@ -437,7 +437,7 @@ class PairPrefetcher:
             raise ValueError("bad prefetcher arguments (empty data or batch > n)")
 
     def __iter__(self):
-        while True:
+        while self._h:  # guard: next() after close() must end, not segfault
             centers = np.empty(self.batch_size, dtype=np.int32)
             contexts = np.empty(self.batch_size, dtype=np.int32)
             ok = self._lib.ssn_prefetch_next(self._h, _ptr(centers), _ptr(contexts))
@@ -483,14 +483,18 @@ class WindowPrefetcher:
         lib = _require()
         self._lib = lib
         self.batch_size = batch_size
-        c = np.ascontiguousarray(centers, dtype=np.int32)
-        x = np.ascontiguousarray(contexts, dtype=np.int32)
-        if x.ndim != 2 or x.shape[0] != c.size:
-            raise ValueError(f"contexts must be [n, cw], got {x.shape}")
-        self.cw = x.shape[1]
+        # the C producer BORROWS these buffers (no copy — a [n, 2w] window
+        # array is already the chunk's dominant allocation); the refs below
+        # keep them alive for the handle's lifetime. Callers must not
+        # mutate them while iterating.
+        self._c = np.ascontiguousarray(centers, dtype=np.int32)
+        self._x = np.ascontiguousarray(contexts, dtype=np.int32)
+        if self._x.ndim != 2 or self._x.shape[0] != self._c.size:
+            raise ValueError(f"contexts must be [n, cw], got {self._x.shape}")
+        self.cw = self._x.shape[1]
         self._h = lib.ssn_win_prefetch_open(
-            _ptr(c), _ptr(x), c.size, self.cw, batch_size, block, epochs,
-            capacity, workers, seed,
+            _ptr(self._c), _ptr(self._x), self._c.size, self.cw, batch_size,
+            block, epochs, capacity, workers, seed,
         )
         if not self._h:
             raise ValueError(
@@ -499,7 +503,7 @@ class WindowPrefetcher:
             )
 
     def __iter__(self):
-        while True:
+        while self._h:  # guard: next() after close() must end, not segfault
             centers = np.empty(self.batch_size, dtype=np.int32)
             contexts = np.empty((self.batch_size, self.cw), dtype=np.int32)
             ok = self._lib.ssn_win_prefetch_next(self._h, _ptr(centers), _ptr(contexts))

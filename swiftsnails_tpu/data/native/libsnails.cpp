@@ -832,10 +832,12 @@ struct Prefetcher {
       fy_shuffle(order.data(), n, seed + (uint64_t)e);
       for (int64_t bi = 0; bi < nb; ++bi) {
         std::vector<int32_t> item((size_t)(2 * batch));
-        int64_t* dst = (int64_t*)item.data();
-        const int64_t* src64 = (const int64_t*)cx.data();
+        // memcpy (not int64_t* punning — strict aliasing) still compiles to
+        // one 8-byte load/store per pair
         for (int64_t j = 0; j < batch; ++j)
-          dst[j] = src64[ord[bi * batch + j]];  // whole pair, one access
+          std::memcpy(item.data() + 2 * j,
+                      cx.data() + 2 * ord[bi * batch + j],
+                      2 * sizeof(int32_t));
         std::unique_lock<std::mutex> lk(mu);
         cv_push.wait(lk, [&] { return queue.size() < capacity || closed; });
         if (closed) return;
@@ -912,8 +914,11 @@ extern "C" void ssn_prefetch_close(void* h) {
 // queue + poison-free end: queue_with_capacity parity
 // (src/utils/queue.h:100-108), like the pair Prefetcher above.
 struct WinPrefetcher {
-  std::vector<int32_t> c;   // [n]
-  std::vector<int32_t> x;   // [n, cw] flattened
+  // BORROWED buffers (the Python wrapper keeps the arrays alive for the
+  // handle's lifetime): a [n, 2w] window array is the chunk's dominant
+  // allocation — copying it would double peak memory per chunk
+  const int32_t* c = nullptr;   // [n]
+  const int32_t* x = nullptr;   // [n, cw] flattened
   int cw = 0;
   int64_t batch = 0, block = 1;
   int64_t nblocks = 0, blocks_per_batch = 0, batches_per_epoch = 0;
@@ -942,9 +947,9 @@ struct WinPrefetcher {
                            (t % batches_per_epoch) * blocks_per_batch;
       for (int64_t bi = 0; bi < blocks_per_batch; ++bi) {
         int64_t src = ord[bi] * block;
-        std::memcpy(co + bi * block, c.data() + src,
+        std::memcpy(co + bi * block, c + src,
                     (size_t)block * sizeof(int32_t));
-        std::memcpy(xo + bi * block * cw, x.data() + src * cw,
+        std::memcpy(xo + bi * block * cw, x + src * cw,
                     (size_t)(block * cw) * sizeof(int32_t));
       }
       std::unique_lock<std::mutex> lk(mu);
@@ -970,8 +975,8 @@ extern "C" void* ssn_win_prefetch_open(const int32_t* centers,
   if (block <= 0) block = 1;
   if (batch % block) return nullptr;  // kernel blocks must tile batches
   WinPrefetcher* p = new WinPrefetcher();
-  p->c.assign(centers, centers + n);
-  p->x.assign(ctxs, ctxs + n * cw);
+  p->c = centers;
+  p->x = ctxs;
   p->cw = cw;
   p->batch = batch;
   p->block = block;

@@ -281,9 +281,13 @@ def create_packed_small_table(
 
 def pull_packed_small(
     state: PackedTableState, rows: jax.Array, dim: int,
-    block_rows: int = 512,
+    block_rows: int = 512, kernel: bool = True,
 ) -> jax.Array:
-    """Gather logical rows -> [N, dim] (tile DMA + in-register lane select)."""
+    """Gather logical rows -> [N, dim] (tile DMA + in-register lane select).
+
+    ``kernel=False`` forces the XLA gather — required when the table is a
+    GLOBAL sharded array outside shard_map (e.g. text export under a mesh),
+    where the row-DMA kernel cannot be auto-partitioned."""
     from swiftsnails_tpu.ops import rowdma
     from swiftsnails_tpu.ops.rowdma import ROW_LANES
 
@@ -291,7 +295,7 @@ def pull_packed_small(
     stride = ROW_LANES // g
     n = rows.shape[0]
     tiles = rows // g
-    if rowdma.on_tpu():
+    if rowdma.on_tpu() and kernel:
         padded, _ = _pad_to_block(tiles, 0, block_rows)
         gathered = rowdma.gather_rows(state.table, padded, block_rows=block_rows)[:n]
     else:

@@ -300,6 +300,34 @@ def ctr_lab(argv=None):
                      dense_state, jax.jit(dense_step, donate_argnums=(0,)))
     print(f"small-row plane speedup: {t_dense / t_small:.2f}x")
 
+    # mesh path: the same plane through the collective twins (shard_map,
+    # tile-granular ownership). On the one real chip this is a (1, 1) mesh —
+    # it measures the collective plane's dispatch/overhead envelope; the
+    # cross-shard traffic itself needs real ICI (same caveat as --push).
+    from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+    from swiftsnails_tpu.parallel.transfer import (
+        pull_collective_packed_small,
+        push_collective_packed_small,
+    )
+
+    n_dev = len(jax.devices())
+    model = max(d for d in (4, 2, 1) if n_dev % d == 0 and (n_dev // d) > 0)
+    mesh = make_mesh({DATA_AXIS: n_dev // model, MODEL_AXIS: model})
+
+    def mesh_state():
+        return create_packed_small_table(cap, dim, access, mesh=mesh, seed=0)
+
+    def mesh_step(state):
+        vals = pull_collective_packed_small(mesh, state, rows, dim)
+        state = push_collective_packed_small(
+            mesh, state, rows, grads + vals * 1e-6, access, 0.01, dim)
+        return state, state.table[0, 0, 0]
+
+    t_mesh = timeit(
+        f"mesh small-plane pull+push (data={n_dev // model}, model={model})",
+        mesh_state, jax.jit(mesh_step, donate_argnums=(0,)))
+    print(f"mesh-path overhead vs single-device plane: {t_mesh / t_small:.2f}x")
+
 
 def push_lab():
     """Gather vs owner-bucketed push on the virtual CPU mesh.
