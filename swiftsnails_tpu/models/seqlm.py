@@ -13,7 +13,8 @@ with the embedding/vocab kept replicated (vocabularies here are the sparse
 tables' job). bf16-friendly; losses/softmax statistics in f32.
 
 Config keys: ``seq_len``, ``n_layers``, ``n_heads``, ``d_model``,
-``attention`` (``ring`` | ``ulysses`` | ``dense``), plus the usual
+``attention`` (``ring`` | ``ulysses`` | ``dense``), ``optimizer``
+(``sgd`` | ``momentum`` | ``adam`` | ``adamw``), plus the usual
 ``learning_rate``, ``batch_size``, ``num_iters``, ``data``.
 """
 
@@ -25,6 +26,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import optax
 
 from swiftsnails_tpu.framework.trainer import Trainer
 from swiftsnails_tpu.models.registry import register_model
@@ -59,6 +61,20 @@ class SeqLMTrainer(Trainer):
         self.batch_size = cfg.get_int("batch_size", 8)
         self.epochs = cfg.get_int("num_iters", 1)
         self.seed = cfg.get_int("seed", 0)
+        # optimizer choice, same contract as the CTR families ("sgd" default
+        # = the bare SGD this trainer always ran; state carries the optax
+        # slots so adam/momentum checkpoint-resume exactly)
+        opt_name = cfg.get_str("optimizer", "sgd")
+        opts = {
+            "sgd": lambda: optax.sgd(self.lr),
+            "momentum": lambda: optax.sgd(self.lr, momentum=0.9),
+            "adam": lambda: optax.adam(self.lr),
+            "adamw": lambda: optax.adamw(self.lr),
+        }
+        if opt_name not in opts:
+            raise ValueError(
+                f"optimizer must be one of {sorted(opts)}, got {opt_name}")
+        self.opt = opts[opt_name]()
         if corpus_ids is None:
             from swiftsnails_tpu.data.text import encode_corpus
 
@@ -101,7 +117,18 @@ class SeqLMTrainer(Trainer):
                 "w1": jax.random.normal(k[2], (d, 4 * d)) * scale,
                 "w2": jax.random.normal(k[3], (4 * d, d)) * (4 * d) ** -0.5,
             })
-        return params
+        state = {"params": params, "opt": self.opt.init(params)}
+        if self.mesh is not None:
+            # params/slots are replicated (vocab scale is the sparse tables'
+            # job); commit them to the WHOLE mesh so checkpoint restore —
+            # which lands on the template's shardings — and the shard_map
+            # attention agree on devices
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep), state)
+        return state
 
     def _attend(self, q, k, v):
         if self.attention == "dense" or self.mesh is None:
@@ -150,11 +177,13 @@ class SeqLMTrainer(Trainer):
                 toks = np.stack([ids[i * window : (i + 1) * window] for i in idx])
                 yield {"tokens": toks.astype(np.int32)}
 
-    def train_step(self, params, batch, rng):
+    def train_step(self, state, batch, rng):
         del rng
-        loss, grads = jax.value_and_grad(self.loss_fn)(params, batch["tokens"])
-        params = jax.tree_util.tree_map(lambda p, g: p - self.lr * g, params, grads)
-        return params, {"loss": loss}
+        loss, grads = jax.value_and_grad(self.loss_fn)(
+            state["params"], batch["tokens"])
+        updates, opt = self.opt.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": opt}, {"loss": loss}
 
     def items_per_batch(self, batch) -> int:
         return int(batch["tokens"].shape[0] * (batch["tokens"].shape[1] - 1))

@@ -166,9 +166,10 @@ class Word2VecTrainer(Trainer):
         self.hot_rows = cfg.get_int("hot_rows", 1024)
         # dedup: 1 -> per-block context-read dedup (fused_sgns_dedup_step)
         # over BLOCK-ORDERED batches: one DMA per distinct context row per
-        # block instead of per slot. Takes precedence over resident (it
-        # targets the same duplicate traffic, without burning VMEM on a
-        # global head). Requires grouped: 1.
+        # block instead of per slot. Requires grouped: 1. COMPOSES with
+        # resident: 1 (fused_sgns_dedup_resident_step): the zipf head lives
+        # VMEM-resident while cold context rows keep the dedup treatment —
+        # requires u_cap >= effective hot_rows (the kernel enforces it).
         self.dedup = cfg.get_bool("dedup", False) and self.grouped
         if cfg.get_bool("dedup", False) and not cfg.get_bool("grouped", False):
             raise ValueError("dedup: 1 requires grouped: 1")
@@ -561,6 +562,7 @@ class Word2VecTrainer(Trainer):
         (fused_sgns_resident_step)."""
         from swiftsnails_tpu.ops import rowdma
         from swiftsnails_tpu.ops.fused_sgns import (
+            fused_sgns_dedup_resident_step,
             fused_sgns_dedup_step,
             fused_sgns_grouped_step,
             fused_sgns_resident_step,
@@ -578,7 +580,12 @@ class Word2VecTrainer(Trainer):
         )  # hash real ids only; pads stay -1
         # resident needs >= 8 hot rows after clipping to capacity
         hot_n = min(self.hot_rows, self.capacity)
-        if self.dedup:
+        if self.dedup and self.resident and hot_n >= 8:
+            step_fn = functools.partial(
+                fused_sgns_dedup_resident_step, u_cap=self.u_cap,
+                hot_rows=hot_n,
+            )
+        elif self.dedup:
             step_fn = functools.partial(fused_sgns_dedup_step, u_cap=self.u_cap)
         elif self.resident and hot_n >= 8:
             step_fn = functools.partial(

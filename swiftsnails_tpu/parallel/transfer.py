@@ -319,8 +319,6 @@ def push_collective_packed_small(
         check_vma=False,
     )
     table, slots = fn(state.table, dict(state.slots), rows, grads)
-    from swiftsnails_tpu.parallel.store import PackedTableState
-
     return PackedTableState(table=table, slots=slots)
 
 

@@ -427,6 +427,151 @@ def test_dedup_matches_sequential_reference(seed, u_cap):
     np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
 
 
+# ------------------------------------------------------ dedup + resident ---
+
+
+def reference_dedup_resident(in_t, out_t, centers, ctxs, pool_rows, lr, lam,
+                             window, pc, pn, u_cap, hot_n):
+    """Sequential reference for the composed kernel: rows < hot_n live in a
+    resident copy (reads current, exact merged sums from every appearance —
+    centers, pool, unique ctx entries). Cold ctx rows rank AFTER hot ones
+    (hot-first ascending, then cold ascending); cold in-list uniques read
+    the <= b-2 snapshot and get one merged write; direct overflow (always
+    cold, since u_cap >= hot_n) and cold centers/pool keep the hogwild
+    last-write-wins semantics. Write order: centers, direct ctx (c-major),
+    pool, cold uniques ascending."""
+    in_t = in_t.copy()
+    out_t = out_t.copy()
+    hi, ho = in_t[:hot_n].copy(), out_t[:hot_n].copy()
+    n, cw = ctxs.shape
+    nblocks = n // pc
+    inv_b = 1.0 / (n * (window + 1))
+    d = in_t.shape[1] * in_t.shape[2]
+    shape = in_t.shape[1:]
+    total_loss = 0.0
+    snap_in, snap_out = in_t.copy(), out_t.copy()
+    for blk in range(nblocks):
+        cr = centers[blk * pc : (blk + 1) * pc]
+        cx = ctxs[blk * pc : (blk + 1) * pc]
+        qr = pool_rows[blk * pn : (blk + 1) * pn]
+        rows = sorted({int(r) for r in cx.reshape(-1) if r >= 0})
+        ranked = [r for r in rows if r < hot_n] + [r for r in rows if r >= hot_n]
+        uniq_rows = ranked[:u_cap]
+        rank = {r: i for i, r in enumerate(ranked)}
+        V = np.stack([
+            (hi[r] if r < hot_n else snap_in[r]).reshape(d) for r in cr
+        ]).astype(np.float32)
+        U = np.zeros((cw, pc, d), np.float32)
+        mask = np.zeros((cw, pc), np.float32)
+        for p in range(pc):
+            for c in range(cw):
+                r = cx[p, c]
+                if r >= 0:
+                    U[c, p] = (ho[r] if r < hot_n else snap_out[r]).reshape(d)
+                    mask[c, p] = 1.0
+        Q = np.stack([
+            (ho[r] if r < hot_n else snap_out[r]).reshape(d) for r in qr
+        ]).astype(np.float32)
+        uniq_base = {
+            r: (ho[r] if r < hot_n else snap_out[r]).reshape(d).copy()
+            for r in uniq_rows
+        }
+        snap_in, snap_out = in_t.copy(), out_t.copy()
+        pos = (U * V[None]).sum(-1)
+        n_real = mask.sum(0)
+        neg = V @ Q.T
+        g_pos = (_sigmoid(pos) - 1.0) * inv_b * mask
+        g_neg = lam * inv_b * _sigmoid(neg) * n_real[:, None]
+        dV = (g_pos[:, :, None] * U).sum(0) + g_neg @ Q
+        dU = g_pos[:, :, None] * V[None]
+        dQ = g_neg.T @ V
+        dv_hot = np.zeros((hot_n, d), np.float32)
+        du_hot = np.zeros((hot_n, d), np.float32)
+        for p in range(pc):
+            if cr[p] < hot_n:
+                dv_hot[cr[p]] += dV[p]
+            else:
+                in_t[cr[p]] = (V[p] - lr * dV[p]).reshape(shape)
+        du_uniq = {r: np.zeros(d, np.float32) for r in uniq_rows}
+        for c in range(cw):
+            for p in range(pc):
+                r = cx[p, c]
+                if r >= 0:
+                    if rank[int(r)] < u_cap:
+                        du_uniq[int(r)] += dU[c, p]
+                    else:  # overflow: always cold (u_cap >= hot_n)
+                        out_t[r] = (U[c, p] - lr * dU[c, p]).reshape(shape)
+        for q in range(pn):
+            if qr[q] < hot_n:
+                du_hot[qr[q]] += dQ[q]
+            else:
+                out_t[qr[q]] = (Q[q] - lr * dQ[q]).reshape(shape)
+        for r in uniq_rows:
+            if r < hot_n:
+                du_hot[r] += du_uniq[r]
+            else:  # cold merged write, ascending order
+                out_t[r] = (uniq_base[r] - lr * du_uniq[r]).reshape(shape)
+        hi -= (lr * dv_hot).reshape((hot_n,) + shape)
+        ho -= (lr * du_hot).reshape((hot_n,) + shape)
+        total_loss += -(
+            (np.log(_sigmoid(pos)) * mask).sum()
+            + lam * (np.log(_sigmoid(-neg)) * n_real[:, None]).sum()
+        ) * inv_b
+    in_t[:hot_n] = hi
+    out_t[:hot_n] = ho
+    return in_t, out_t, total_loss
+
+
+@pytest.mark.parametrize("seed,u_cap,hot_rows", [
+    (0, 64, 32),   # mixed hot/cold, every distinct row in-list
+    (1, 64, 32),
+    (0, 16, 8),    # mixed + direct-overflow traffic
+    (0, 64, 64),   # fully hot (= capacity): fully deterministic
+])
+def test_dedup_resident_matches_sequential_reference(seed, u_cap, hot_rows):
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_dedup_resident_step
+
+    rng = np.random.default_rng(seed)
+    C, S, L = 64, 2, 128
+    N, PC, PN, W = 32, 8, 4, 3
+    CW = 2 * W
+    in_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    out_t = rng.normal(size=(C, S, L)).astype(np.float32) * 0.1
+    centers = rng.integers(0, C, N).astype(np.int32)
+    ctxs = (centers[:, None] + rng.integers(-3, 4, (N, CW))).astype(np.int32) % C
+    ctxs[rng.random((N, CW)) < 0.4] = -1
+    ctxs[3] = -1
+    pool_rows = rng.integers(0, C, (N // PC) * PN).astype(np.int32)
+    lr, lam = 0.05, 0.625
+
+    want_in, want_out, want_loss = reference_dedup_resident(
+        in_t, out_t, centers, ctxs, pool_rows, lr, lam, W, PC, PN,
+        u_cap, hot_rows,
+    )
+    got_in, got_out, got_loss = fused_sgns_dedup_resident_step(
+        jnp.asarray(in_t), jnp.asarray(out_t), jnp.asarray(centers),
+        jnp.asarray(ctxs), jnp.asarray(pool_rows),
+        lr=lr, lam=lam, window=W, centers_per_block=PC, pool_size=PN,
+        u_cap=u_cap, hot_rows=hot_rows, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got_in), want_in, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(got_out), want_out, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
+
+
+def test_dedup_resident_rejects_small_u_cap():
+    from swiftsnails_tpu.ops.fused_sgns import fused_sgns_dedup_resident_step
+
+    t = jnp.zeros((64, 2, 128), jnp.float32)
+    with pytest.raises(ValueError, match="u_cap"):
+        fused_sgns_dedup_resident_step(
+            t, t, jnp.zeros(8, jnp.int32), jnp.zeros((8, 6), jnp.int32),
+            jnp.zeros(4, jnp.int32), lr=0.1, lam=0.5, window=3,
+            centers_per_block=8, pool_size=4, u_cap=8, hot_rows=32,
+            interpret=True,
+        )
+
+
 def test_dedup_trainer_trains_toy_corpus():
     """dedup: 1 end to end through the trainer (block-ordered batches),
     CPU interpret."""

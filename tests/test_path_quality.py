@@ -34,6 +34,10 @@ PATHS = {
     # exact merged updates; block-granular shuffle changes the SGD mixing
     "fused_dedup": {"packed": "1", "neg_mode": "pool", "fused": "1",
                     "grouped": "1", "dedup": "1"},
+    # composed: zipf head VMEM-resident + cold contexts dedup'd (at probe
+    # scale the whole table is hot -> fully deterministic merged updates)
+    "fused_dedup_res": {"packed": "1", "neg_mode": "pool", "fused": "1",
+                        "grouped": "1", "dedup": "1", "resident": "1"},
 }
 
 

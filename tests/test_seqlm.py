@@ -30,15 +30,40 @@ def _cfg(**kw):
 
 def test_seqlm_loss_decreases():
     tr = SeqLMTrainer(_cfg(), corpus_ids=_corpus(), vocab_size=32)
-    params = tr.init_state()
+    state = tr.init_state()
     step = jax.jit(tr.train_step)
     losses = []
     for i, b in enumerate(tr.batches()):
-        params, m = step(params, {k: jnp.asarray(v) for k, v in b.items()}, None)
+        state, m = step(state, {k: jnp.asarray(v) for k, v in b.items()}, None)
         losses.append(float(m["loss"]))
         if len(losses) >= 80:
             break
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses[:3] + losses[-3:]
+
+
+@pytest.mark.parametrize("optimizer", ["momentum", "adam"])
+def test_seqlm_optimizer_choice_trains(optimizer):
+    """The optimizer contract (same config key as the CTR families): slots
+    live in the state, training converges."""
+    lr = "0.003" if optimizer == "adam" else "0.05"
+    tr = SeqLMTrainer(_cfg(optimizer=optimizer, learning_rate=lr),
+                      corpus_ids=_corpus(), vocab_size=32)
+    state = tr.init_state()
+    assert "opt" in state and state["opt"]  # real slots, not empty
+    step = jax.jit(tr.train_step)
+    losses = []
+    for i, b in enumerate(tr.batches()):
+        state, m = step(state, {k: jnp.asarray(v) for k, v in b.items()}, None)
+        losses.append(float(m["loss"]))
+        if len(losses) >= 60:
+            break
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses[:3] + losses[-3:]
+
+
+def test_seqlm_unknown_optimizer_rejected():
+    with pytest.raises(ValueError, match="optimizer"):
+        SeqLMTrainer(_cfg(optimizer="rmsprop"), corpus_ids=_corpus(400),
+                     vocab_size=32)
 
 
 @pytest.mark.parametrize("attention", ["ring", "ulysses"])
@@ -48,10 +73,45 @@ def test_seqlm_seq_parallel_matches_dense(attention):
     dense = SeqLMTrainer(_cfg(), corpus_ids=corpus, vocab_size=32)
     par = SeqLMTrainer(_cfg(attention=attention), mesh=mesh,
                        corpus_ids=corpus, vocab_size=32)
-    params = dense.init_state()
+    params = dense.init_state()["params"]
     batch = next(iter(dense.batches()))
     toks = jnp.asarray(batch["tokens"])[:, :-1]
     want = dense.forward(params, toks)
     got = par.forward(params, toks)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_seqlm_checkpoint_roundtrip_under_seq_mesh(tmp_path):
+    """Save mid-training under a (data, seq) mesh, restore, and continue:
+    restored losses must match an uninterrupted run (the adam slots and
+    params both survive the round trip) — the same bar the other trainer
+    families meet (VERDICT r3 next #9)."""
+    from swiftsnails_tpu.framework.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 2}, devices=jax.devices()[:4])
+    corpus = _corpus(3000)
+    tr = SeqLMTrainer(_cfg(attention="ring", optimizer="adam",
+                           learning_rate="0.003"),
+                      mesh=mesh, corpus_ids=corpus, vocab_size=32)
+    state = tr.init_state()
+    step = jax.jit(tr.train_step)
+    batches = [
+        {k: jnp.asarray(v) for k, v in b.items()} for b in tr.batches()
+    ][:6]
+    for b in batches[:3]:
+        state, _ = step(state, b, None)
+    save_checkpoint(str(tmp_path / "ck"), state, step=3)
+    cont = []
+    for b in batches[3:]:
+        state, m = step(state, b, None)
+        cont.append(float(m["loss"]))
+    restored = restore_checkpoint(str(tmp_path / "ck"), tr.init_state())
+    resumed = []
+    for b in batches[3:]:
+        restored, m = step(restored, b, None)
+        resumed.append(float(m["loss"]))
+    np.testing.assert_allclose(resumed, cont, rtol=1e-5)

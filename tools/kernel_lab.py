@@ -153,6 +153,7 @@ def resident_lab(argv=None):
     )
     from swiftsnails_tpu.ops import rowdma
     from swiftsnails_tpu.ops.fused_sgns import (
+        fused_sgns_dedup_resident_step,
         fused_sgns_dedup_step,
         fused_sgns_grouped_step,
         fused_sgns_resident_step,
@@ -172,24 +173,30 @@ def resident_lab(argv=None):
     ids = zipf(400_000)
     g_c, g_x = skipgram_windows(ids, W, rng)
     b_shuf = next(batch_stream(g_c, g_x, N, rng))
-    b_blk = next(batch_stream_blocks(g_c, g_x, N, rng, block=256))
+    # block-ordered batches per kernel block size (the sampler block must
+    # equal the kernel's centers_per_block — the locality the dedup copy
+    # list converts into fewer DMAs); --quick only consumes pc=256
+    b_blk = {
+        pc: next(batch_stream_blocks(g_c, g_x, N, rng, block=pc))
+        for pc in ((256,) if args.quick else (128, 256, 512))
+    }
     in_np = rng.random((args.vocab, S, 128), dtype=np.float32)
 
-    def timeit(fn, name, batch, reps=12, **kw):
+    def timeit(fn, name, batch, reps=12, pc=256, **kw):
         cj = jnp.asarray(batch["centers"])
         xj = jnp.asarray(batch["contexts"])
         a = jnp.asarray(in_np)
         b = jnp.zeros((args.vocab, S, 128), jnp.float32)
-        pool = jnp.asarray(zipf((N // 256) * PN))
+        pool = jnp.asarray(zipf((N // pc) * PN))
         try:
             a, b, loss = fn(a, b, cj, xj, pool, lr=0.025, lam=5 / PN,
-                            window=W, centers_per_block=256, pool_size=PN,
+                            window=W, centers_per_block=pc, pool_size=PN,
                             interpret=interp, **kw)
             _ = float(loss)
             t0 = time.perf_counter()
             for _i in range(reps):
                 a, b, loss = fn(a, b, cj, xj, pool, lr=0.025,
-                                lam=5 / PN, window=W, centers_per_block=256,
+                                lam=5 / PN, window=W, centers_per_block=pc,
                                 pool_size=PN, interpret=interp, **kw)
             _ = float(loss)  # force the donated chain through the tunnel
             dt = (time.perf_counter() - t0) / reps
@@ -202,22 +209,37 @@ def resident_lab(argv=None):
             return 0.0
 
     results = {}
-    results["dedup u_cap=384"] = timeit(
-        fused_sgns_dedup_step, "dedup u_cap=384 (block-ordered)", b_blk,
-        u_cap=384)
+    results["dedup pc=256 u_cap=384"] = timeit(
+        fused_sgns_dedup_step, "dedup pc=256 u_cap=384 (block-ordered)",
+        b_blk[256], u_cap=384)
     results["grouped"] = timeit(
         fused_sgns_grouped_step, "grouped (shuffled)", b_shuf)
     if not args.quick:
         results["grouped block"] = timeit(
-            fused_sgns_grouped_step, "grouped (block-ordered)", b_blk)
-        for uc in (256, 512):
-            results[f"dedup u_cap={uc}"] = timeit(
-                fused_sgns_dedup_step, f"dedup u_cap={uc} (block-ordered)",
-                b_blk, u_cap=uc)
+            fused_sgns_grouped_step, "grouped (block-ordered)", b_blk[256])
+        # pc x u_cap sweep: u_cap must cover the block's distinct-row count
+        # (~pc on block-ordered corpus) or overflow slots fall back to
+        # per-slot hogwild copies; beyond that it only grows the one-hot
+        # broadcast matmuls
+        for pc, ucs in ((128, (128, 256)), (256, (256, 512, 1024)),
+                        (512, (512, 768))):
+            for uc in ucs:
+                if pc == 256 and uc == 384:
+                    continue  # measured above
+                results[f"dedup pc={pc} u_cap={uc}"] = timeit(
+                    fused_sgns_dedup_step,
+                    f"dedup pc={pc} u_cap={uc} (block-ordered)",
+                    b_blk[pc], pc=pc, u_cap=uc)
         for hot in (512, 2048):
             results[f"resident hot={hot}"] = timeit(
                 fused_sgns_resident_step, f"resident hot={hot} (shuffled)",
                 b_shuf, hot_rows=hot)
+        # composed: head resident + cold dedup (u_cap >= hot required)
+        for uc, hot in ((384, 256), (512, 512), (1024, 1024)):
+            results[f"dedup+res u={uc} hot={hot}"] = timeit(
+                fused_sgns_dedup_resident_step,
+                f"dedup+res pc=256 u_cap={uc} hot={hot} (block-ordered)",
+                b_blk[256], u_cap=uc, hot_rows=hot)
     best = max(results, key=results.get)
     print(f"best: {best} ({results[best]:,.0f} words/sec)")
 
