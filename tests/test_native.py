@@ -187,6 +187,27 @@ def test_window_prefetcher_deterministic_across_workers():
         np.testing.assert_array_equal(a, b)
 
 
+def test_window_prefetcher_multi_epoch_full_coverage():
+    """epochs=2 delivers every window exactly twice, reshuffled per epoch."""
+    n, cw, bs = 4_096, 4, 512
+    g_c = np.arange(n, dtype=np.int32)
+    g_x = np.repeat(g_c[:, None], cw, axis=1)
+    wp = native.WindowPrefetcher(g_c, g_x, bs, block=128, epochs=2, seed=5)
+    seen = [b["centers"] for b in wp]
+    wp.close()
+    assert len(seen) == 2 * (n // bs)
+    per_epoch = n // bs
+    e1 = np.sort(np.concatenate(seen[:per_epoch]))
+    e2 = np.sort(np.concatenate(seen[per_epoch:]))
+    np.testing.assert_array_equal(e1, g_c)
+    np.testing.assert_array_equal(e2, g_c)
+    # epochs reshuffle (astronomically unlikely to match if shuffled)
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(seen[:per_epoch], seen[per_epoch:])
+    )
+
+
 def test_window_prefetcher_early_close_no_hang():
     n = 65_536
     g_c = np.arange(n, dtype=np.int32)

@@ -69,6 +69,11 @@ def test_bf16_tables_train_dedup_path():
     assert top1 >= MIN_TOP1, f"bf16 dedup: pair top-1 {top1:.3f} < {MIN_TOP1}"
 
 
+def test_bf16_tables_train_dedup_res_path():
+    top1 = probe_top1({**PATHS["fused_dedup_res"], "table_dtype": "bfloat16"})
+    assert top1 >= MIN_TOP1, f"bf16 dedup+res: pair top-1 {top1:.3f} < {MIN_TOP1}"
+
+
 def test_hash_collisions_still_train():
     """hash_keys: 1 at 1:1 load (128 words into 128 rows, the same load
     factor as the 1M-vocab/2^20-capacity north-star config) — uniform
