@@ -179,6 +179,24 @@ class SparseCTRTrainer(Trainer):
             )
         dense = self.init_dense(jax.random.PRNGKey(self.seed + 17))
         opt = self.dense_opt.init(dense)
+        if self.mesh is not None:
+            # commit the replicated dense/opt pytrees to the WHOLE mesh
+            # (TP-sharded leaves are placed by init_dense itself and keep
+            # their sharding): checkpoint restore lands on the template's
+            # shardings, and a single-device-committed leaf would conflict
+            # with the mesh-sharded table in the restored train_step
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.mesh, PartitionSpec())
+
+            def place(x):
+                s = getattr(x, "sharding", None)
+                if isinstance(s, NamedSharding) and s.mesh == self.mesh:
+                    return x  # already mesh-placed (e.g. dense_tp leaves)
+                return jax.device_put(x, rep)
+
+            dense = jax.tree_util.tree_map(place, dense)
+            opt = jax.tree_util.tree_map(place, opt)
         return CTRState(table=table, dense=dense, opt=opt)
 
     def _pull_rows(self, table_state, rows: jax.Array) -> jax.Array:
