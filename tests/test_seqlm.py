@@ -60,6 +60,18 @@ def test_seqlm_optimizer_choice_trains(optimizer):
     assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.7, losses[:3] + losses[-3:]
 
 
+def test_seqlm_runs_under_train_loop():
+    """The production driver (jit + donation + prefetch + metrics) must
+    drive this trainer like every other family — the seqlm contract isn't
+    just train_step-callable."""
+    from swiftsnails_tpu.framework.trainer import TrainLoop
+
+    tr = SeqLMTrainer(_cfg(num_iters="2"), corpus_ids=_corpus(4000),
+                      vocab_size=32)
+    state = TrainLoop(tr, log_every=0).run()
+    assert sorted(state.keys()) == ["opt", "params"]
+
+
 def test_seqlm_unknown_optimizer_rejected():
     with pytest.raises(ValueError, match="optimizer"):
         SeqLMTrainer(_cfg(optimizer="rmsprop"), corpus_ids=_corpus(400),
