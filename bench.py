@@ -95,8 +95,9 @@ _state = {
     "baseline_runs": [],  # per-run per-node words/sec (spread evidence)
     "spread": {},  # name -> relative spread between repeated measure windows
     "pairs_per_token": None,
-    "input_words_per_sec": None,  # host pipeline rate (words/sec equivalent)
+    "input_words_per_sec": None,  # flat-pair host pipeline (non-grouped paths)
     "input_words_per_sec_grouped": None,  # window-schema pipeline (grouped path)
+    "input_words_per_sec_production": None,  # the pipeline feeding the headline
     "platform": None,
     "at_scale": None,  # planted-pair structure at bench scale (dict)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
@@ -193,6 +194,9 @@ def _result_json(extra_error=None):
             "input_words_per_sec": _finite(_state["input_words_per_sec"] or 0, 1) or None,
             "input_words_per_sec_grouped": _finite(
                 _state["input_words_per_sec_grouped"] or 0, 1
+            ) or None,
+            "input_words_per_sec_production": _finite(
+                _state.get("input_words_per_sec_production") or 0, 1
             ) or None,
             "platform": _state["platform"],
             "at_scale": _state["at_scale"],
@@ -1052,11 +1056,16 @@ def main():
         measure_input_pipeline(ids, pairs_per_token)
     except Exception as e:
         _state["errors"].append(f"input pipeline measurement failed: {e}")
+    grouped_family = {"fused-grouped", "fused-resident", "fused-dedup",
+                      "fused-dedup-res"}
     in_rate = (
         _state["input_words_per_sec_grouped"]
-        if _state["best_path"] == "fused-grouped"
+        if _state["best_path"] in grouped_family
         else _state["input_words_per_sec"]
     )
+    # the rate of the pipeline that actually feeds the headline path — the
+    # number the >=2x-the-chip producer target is judged against
+    _state["input_words_per_sec_production"] = in_rate
     if in_rate and _state["best"] and in_rate < _state["best"]:
         _state["errors"].append(
             f"input pipeline ({in_rate:,.0f} words/s) below device rate "
