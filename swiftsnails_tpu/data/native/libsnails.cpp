@@ -833,11 +833,16 @@ struct Prefetcher {
       for (int64_t bi = 0; bi < nb; ++bi) {
         std::vector<int32_t> item((size_t)(2 * batch));
         // memcpy (not int64_t* punning — strict aliasing) still compiles to
-        // one 8-byte load/store per pair
-        for (int64_t j = 0; j < batch; ++j)
-          std::memcpy(item.data() + 2 * j,
-                      cx.data() + 2 * ord[bi * batch + j],
+        // one 8-byte load/store per pair; the gather is random-access over
+        // the whole pair array, so prefetch a few iterations ahead to
+        // overlap the DRAM misses
+        const uint32_t* o = ord + bi * batch;
+        for (int64_t j = 0; j < batch; ++j) {
+          if (j + 8 < batch)
+            __builtin_prefetch(cx.data() + 2 * (int64_t)o[j + 8], 0, 0);
+          std::memcpy(item.data() + 2 * j, cx.data() + 2 * (int64_t)o[j],
                       2 * sizeof(int32_t));
+        }
         std::unique_lock<std::mutex> lk(mu);
         cv_push.wait(lk, [&] { return queue.size() < capacity || closed; });
         if (closed) return;
