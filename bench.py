@@ -1125,6 +1125,15 @@ def _emit_cached_fallback() -> bool:
         return False
     cached["cached"] = True
     cached["cache_measured_at"] = cached.pop("measured_at", None)
+    # the pinned baseline is a property of the machine, not of the cached
+    # run — refresh it so even an outage emit reports the calibrated
+    # multiple (a cache saved before calibration lacks the fields)
+    pinned = _pinned_baseline()
+    pinned_8 = (pinned or {}).get("baseline_words_per_sec_8node_pinned")
+    if pinned_8 and cached.get("value"):
+        cached["vs_baseline_pinned"] = round(cached["value"] / pinned_8, 3)
+        cached["baseline_words_per_sec_8node_pinned"] = pinned_8
+        cached["baseline_pinned_at"] = pinned.get("calibrated_at")
     # keep the cached run's own caveats AND add the live outage error
     cached["errors"] = list(cached.get("errors", [])) + list(_state["errors"]) + [
         "accelerator unavailable NOW; value above is the last successful "
