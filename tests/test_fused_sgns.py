@@ -559,6 +559,20 @@ def test_dedup_resident_matches_sequential_reference(seed, u_cap, hot_rows):
     np.testing.assert_allclose(float(got_loss), want_loss, rtol=1e-4)
 
 
+def test_composed_vmem_check_models_union():
+    """The composed kernel's fail-fast must model the UNION of the dedup
+    scratch and the resident head buffers — a config each single-kernel
+    check would pass can overflow combined."""
+    from swiftsnails_tpu.ops.fused_sgns import _check_dedup_vmem
+
+    row = (8, 128)  # 4 KiB rows
+    # ~94 MiB as plain dedup: passes...
+    _check_dedup_vmem(1536, 256, 2560, 64, row, jnp.float32)
+    # ...but + the resident head buffers (~12 MiB) it must raise
+    with pytest.raises(ValueError, match="composed"):
+        _check_dedup_vmem(1536, 256, 2560, 64, row, jnp.float32, hot_n=1536)
+
+
 def test_dedup_resident_rejects_small_u_cap():
     from swiftsnails_tpu.ops.fused_sgns import fused_sgns_dedup_resident_step
 
