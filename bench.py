@@ -24,10 +24,12 @@ the whole deadline and reported 0.0):
     mid-TPU-init is what wedges the grant) — on timeout it is abandoned.
   * the CPU baseline is measured before any TPU work, so a later hang still
     reports vs_baseline context.
-  * TPU paths run SAFEST FIRST (dense XLA, then packed, then the fused
-    Mosaic kernel); every path that completes updates the best-so-far
-    result, and the global watchdog emits that best (exit 0) instead of 0.0
-    if a later path hangs.
+  * TPU paths run PRIORITY FIRST: dense XLA qualifies the chip and holds a
+    fallback headline, then the decisive fused-dedup/composed kernels
+    (never yet measured on-chip after two grant outages), then the rest;
+    every path that completes updates the best-so-far result, and the
+    global watchdog emits that best (exit 0) instead of 0.0 if a later
+    path hangs.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -102,6 +104,7 @@ _state = {
     "at_scale": None,  # planted-pair structure at bench scale (dict)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
+    "attempted": set(),  # paths that ran to completion OR failed (not skipped)
     "errors": [],
 }
 # divergence guard on the held-out eval loss: a path whose loss exceeds the
@@ -460,13 +463,12 @@ def measure_tpu_paths(counts, ids, batches, pairs_per_token):
         "pool_size": str(POOL_SIZE),
         "pool_block": str(POOL_BLOCK),
     }
+    # Priority order (VERDICT r4 #1): dense qualifies the chip + holds the
+    # fallback headline, then the DECISIVE never-measured-on-chip paths run
+    # immediately (two grants in a row died before the old tail order
+    # reached them); the previously-measured paths fill in afterwards.
     paths = [
         ("dense", {"packed": "0"}),
-        ("packed+pool", pool),
-        ("fused-hogwild", {**pool, "fused": "1"}),
-        ("fused-grouped", {**pool, "fused": "1", "grouped": "1"}),
-        ("fused-resident", {**pool, "fused": "1", "grouped": "1",
-                            "resident": "1", "hot_rows": str(HOT_ROWS)}),
         ("fused-dedup", {**pool, "fused": "1", "grouped": "1",
                          "dedup": "1", "u_cap": str(U_CAP)}),
         # composed: zipf head VMEM-resident + cold contexts dedup'd
@@ -474,6 +476,11 @@ def measure_tpu_paths(counts, ids, batches, pairs_per_token):
         ("fused-dedup-res", {**pool, "fused": "1", "grouped": "1",
                              "dedup": "1", "resident": "1",
                              "u_cap": str(U_CAP), "hot_rows": "256"}),
+        ("fused-grouped", {**pool, "fused": "1", "grouped": "1"}),
+        ("fused-resident", {**pool, "fused": "1", "grouped": "1",
+                            "resident": "1", "hot_rows": str(HOT_ROWS)}),
+        ("fused-hogwild", {**pool, "fused": "1"}),
+        ("packed+pool", pool),
     ]
     gcache = {}  # block-size -> grouped window batches (0 = shuffled)
     for name, overrides in paths:
@@ -483,6 +490,7 @@ def measure_tpu_paths(counts, ids, batches, pairs_per_token):
                 f"skipped {name}: only {remaining:.0f}s of budget left"
             )
             break
+        _state["attempted"].add(name)
         try:
             grouped = overrides.get("grouped") == "1"
             if grouped:
@@ -694,6 +702,9 @@ def measure_at_scale_structure(counts, path_overrides=None) -> None:
     confus = np.unique(np.concatenate([pair_b + 2, np.maximum(pair_b - 2, 0)]))
     confus = confus[~np.isin(confus, pair_b)].astype(np.int32)
     cand = rng.choice(VOCAB, 8192, replace=False).astype(np.int32)
+    # a true partner duplicated among the random candidates would tie its
+    # own score and zero the margin readout spuriously — exclude
+    cand = cand[~np.isin(cand, pair_b)]
     cand_all = np.concatenate([pair_b, confus, cand])
 
     # window generation, vocab, and batch assembly are identical across the
@@ -770,23 +781,32 @@ def measure_at_scale_structure(counts, path_overrides=None) -> None:
             ].get(mode="promise_in_bounds"), DIM).astype(jnp.float32)
         scores = np.asarray(va @ ub.T)  # [P, P + C + 8192]
         p = len(pair_a)
-        top1 = scores.argmax(axis=1) == np.arange(p)
         # margin: true-partner logit minus best distractor logit — how far
         # retrieval is from flipping, where top-1 alone saturates at 1.0
         true_s = scores[np.arange(p), np.arange(p)]
         masked = scores.copy()
         masked[np.arange(p), np.arange(p)] = -np.inf
         margin = true_s - masked.max(axis=1)
+        # STRICT inequality: an exact score tie (e.g. the hash-collision leg
+        # mapping a distractor onto the partner's row) must count as a miss —
+        # argmax's first-occurrence bias would otherwise hide collisions
+        top1 = margin > 0
         by_band = {
             name: float(
                 top1[[i for i, bn in enumerate(band_of) if bn == name]].mean())
             for name in bands
         }
+        # raw logit scale is tiny at bench scale (batch-mean normalized
+        # updates over 1M rows) — report margins at full precision plus the
+        # true-score scale, and the scale-free relative margin
+        denom = np.abs(true_s) + 1e-12
         return {
             "partner_top1": float(top1.mean()),
             "by_band": by_band,
-            "margin_mean": round(float(margin.mean()), 4),
-            "margin_p10": round(float(np.percentile(margin, 10)), 4),
+            "margin_mean": float(margin.mean()),
+            "margin_p10": float(np.percentile(margin, 10)),
+            "margin_rel_mean": round(float((margin / denom).mean()), 4),
+            "true_score_mean": float(true_s.mean()),
             "confusable_distractors": int(len(confus)),
             "planted_pairs": int(p),
             "trained_words": int(trained_words),
@@ -1080,14 +1100,17 @@ def main():
 def _save_last_good():
     """Cache this run for the outage fallback — only if it's a VALID headline
     run: real accelerator, full-size workload (never SSN_BENCH_SMALL), and
-    every path measured (a partial run must not overwrite a complete one)."""
+    every path ATTEMPTED (a budget-truncated run must not overwrite a
+    complete one; a path that ran and failed is recorded in errors and does
+    not block the cache — its absence from ``paths`` plus the error IS the
+    result)."""
     expected_paths = {"dense", "packed+pool", "fused-hogwild", "fused-grouped",
-                      "fused-resident", "fused-dedup"}
+                      "fused-resident", "fused-dedup", "fused-dedup-res"}
     if (
         _SMALL
         or _state["best"] <= 0
         or _state["platform"] == "cpu"
-        or not expected_paths.issubset(_state["paths"])
+        or not expected_paths.issubset(_state["attempted"])
     ):
         return
     try:

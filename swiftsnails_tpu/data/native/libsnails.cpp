@@ -879,7 +879,7 @@ extern "C" void* ssn_prefetch_open(const int32_t* centers, const int32_t* contex
                         int64_t n, int64_t batch, int epochs, int capacity,
                         uint64_t seed) {
   if (n <= 0 || batch <= 0 || batch > n) return nullptr;
-  if (n > (int64_t)1 << 31) return nullptr;  // 32-bit shuffle indices
+  if (n >= (int64_t)1 << 31) return nullptr;  // pair counts < 2^31 (uint32 shuffle indices)
   Prefetcher* p = new Prefetcher();
   p->n = n;
   p->cx.resize((size_t)(2 * n));

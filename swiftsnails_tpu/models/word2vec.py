@@ -382,8 +382,9 @@ class Word2VecTrainer(Trainer):
                     macro = self.batch_size * self.steps_per_call
                     n_batches = max(len(g_c) // macro, 1)
                     # Block-order only where a kernel consumes it: the mesh
-                    # plane does no per-block dedup, so block shuffling there
-                    # would trade SGD mixing for nothing. The sampler block
+                    # plane dedups at SUBSTEP granularity (shard-local unique
+                    # lists, transfer.py), so block shuffling there would
+                    # trade SGD mixing for nothing. The sampler block
                     # must equal the kernel's EFFECTIVE centers_per_block
                     # (largest divisor of the per-substep batch — the same
                     # shrink _substep_grouped applies), so kernel blocks never
@@ -445,6 +446,24 @@ class Word2VecTrainer(Trainer):
                         stream.close()
 
     # -- step --------------------------------------------------------------
+
+    def _mesh_u_cap(self, n: int) -> int:
+        """Static unique-list capacity for the mesh dedup planes: the
+        per-block ``u_cap`` scaled to the data shard's whole substep (the
+        collective planes dedup at SUBSTEP granularity, not kernel-block),
+        clamped to the shard's slot count and rounded up to a sublane
+        multiple. ``mesh_u_cap`` overrides the auto-scale."""
+        override = self.config.get_int("mesh_u_cap", 0)
+        if override:
+            return override
+        from swiftsnails_tpu.parallel.mesh import DATA_AXIS
+
+        d = self.mesh.shape[DATA_AXIS]
+        pc = self._effective_pc(n)
+        local_slots = (n * 2 * self.window + (n // pc) * self.pool_size) // d
+        blocks = max((n // d) // pc, 1)
+        cap = min(self.u_cap * blocks, local_slots)
+        return max(-(-cap // 8) * 8, 8)
 
     def _effective_pc(self, n: int | None = None) -> int:
         """The grouped kernels' EFFECTIVE centers-per-block: the largest
@@ -562,6 +581,7 @@ class Word2VecTrainer(Trainer):
         (fused_sgns_resident_step)."""
         from swiftsnails_tpu.ops import rowdma
         from swiftsnails_tpu.ops.fused_sgns import (
+            effective_hot_rows,
             fused_sgns_dedup_resident_step,
             fused_sgns_dedup_step,
             fused_sgns_grouped_step,
@@ -580,6 +600,20 @@ class Word2VecTrainer(Trainer):
         )  # hash real ids only; pads stay -1
         # resident needs >= 8 hot rows after clipping to capacity
         hot_n = min(self.hot_rows, self.capacity)
+        if self.dedup and self.resident and hot_n >= 8:
+            # the composed kernel requires u_cap >= effective hot rows (hot
+            # entries rank first into the unique list); clamp the head to
+            # what the list can hold instead of raising at the first step,
+            # mirroring the eff<8 grouped fallback below
+            eff, _ = effective_hot_rows(hot_n, self.capacity)
+            if self.u_cap < eff:
+                clamped, _ = effective_hot_rows(
+                    min(hot_n, self.u_cap), self.capacity)
+                logging.getLogger(__name__).warning(
+                    "dedup+resident with u_cap=%d < effective hot_rows=%d: "
+                    "clamping the resident head to %d rows (raise u_cap to "
+                    "keep the full head)", self.u_cap, eff, clamped)
+                hot_n = clamped
         if self.dedup and self.resident and hot_n >= 8:
             step_fn = functools.partial(
                 fused_sgns_dedup_resident_step, u_cap=self.u_cap,
@@ -632,6 +666,16 @@ class Word2VecTrainer(Trainer):
         parity), not the kernel's hogwild — strictly closer to the faithful
         path. ``resident: 1`` has no mesh meaning (VMEM residency is
         per-chip) and quietly uses this plane.
+
+        ``dedup: 1`` keeps its traffic cut here (VERDICT r4 #4): the
+        out-table pull/push route through the shard-local unique-list
+        planes (transfer.pull/push_collective_packed_dedup) — each data
+        shard moves each distinct context/pool row once per substep instead
+        of once per slot, the collective translation of the reference's
+        per-server key grouping (global_pull_access.h:58-72). Distinct rows
+        beyond :meth:`_mesh_u_cap` overflow (zero pull / dropped grad) and
+        surface in the ``dedup_dropped`` metric (``push_dropped`` when
+        combined with bucketed push, which subsumes the push-side dedup).
         """
         n = centers.shape[0]
         cw = ctxs.shape[1]
@@ -650,7 +694,17 @@ class Word2VecTrainer(Trainer):
 
         v = self._ppull(state.in_table, center_rows)  # [n, S, L]
         out_pull_rows = jnp.concatenate([ctx_rows.reshape(-1), pool_rows])
-        u_all = self._ppull(state.out_table, out_pull_rows)
+        d_pull = jnp.int32(0)
+        if self.dedup:
+            from swiftsnails_tpu.parallel.transfer import (
+                pull_collective_packed_dedup,
+            )
+
+            ucap = self._mesh_u_cap(n)
+            u_all, u_index, d_pull = pull_collective_packed_dedup(
+                self.mesh, state.out_table, out_pull_rows, ucap)
+        else:
+            u_all = self._ppull(state.out_table, out_pull_rows)
         u = u_all[: n * cw].reshape((n, cw) + u_all.shape[1:])
         q = u_all[n * cw :].reshape((nb, pn) + u_all.shape[1:])
 
@@ -672,8 +726,20 @@ class Word2VecTrainer(Trainer):
              dq.reshape((nb * pn,) + dq.shape[2:])]
         )
         in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr)
-        out_table, d2 = self._ppush(state.out_table, out_pull_rows, out_grads, lr)
-        return W2VState(in_table, out_table), loss, d1 + d2
+        if self.dedup and self.push_mode != "bucketed":
+            from swiftsnails_tpu.parallel.transfer import (
+                push_collective_packed_dedup,
+            )
+
+            # reuse the pull's unique index: skips the duplicate sort and
+            # keeps the overflow metric single-counted (d2 is 0 here)
+            out_table, d2 = push_collective_packed_dedup(
+                self.mesh, state.out_table, out_pull_rows, out_grads,
+                self.access, lr, ucap, index=u_index)
+        else:
+            out_table, d2 = self._ppush(state.out_table, out_pull_rows,
+                                        out_grads, lr)
+        return W2VState(in_table, out_table), loss, d_pull + d1 + d2
 
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
         """Packed tables with reference-faithful per-pair K negatives."""
@@ -743,6 +809,8 @@ class Word2VecTrainer(Trainer):
             m = {"loss": loss}
             if self.push_mode == "bucketed":
                 m["push_dropped"] = dropped
+            elif self.dedup and self.mesh is not None:
+                m["dedup_dropped"] = dropped
             return m
 
         if t == 1:

@@ -681,7 +681,12 @@ def _check_resident_vmem(hot_n, pc, cap, pn, row_shape, dtype):
     # f32 working set: merged slot values + grads for cap/pc/pn slots, twice
     # over for where-selects and update temporaries
     working = 4 * dp_f32 * (cap + pc + pn)
-    need = scratch + working
+    # one-hot expand temporaries: the [n_rows, ch] one-hot + iota broadcast
+    # intermediates of the head-expansion loops (previously uncounted — a
+    # large hot_n could pass the check and still hit an opaque Mosaic OOM)
+    ch = 256 if hot_n >= 256 else hot_n
+    onehot = 4 * 2 * (cap + pc + pn) * ch
+    need = scratch + working + onehot
     if need > _RESIDENT_VMEM_BYTES:
         raise ValueError(
             f"resident kernel VMEM estimate {need / 2**20:.1f} MiB exceeds "
@@ -709,7 +714,14 @@ def _check_dedup_vmem(u_cap, pc, cap, pn, row_shape, dtype, hot_n=0):
     # for where-selects and update temporaries, plus the one-hot broadcast
     # accumulator and the unique-row update temporaries
     working = 4 * dp_f32 * (cap + pc + pn) + 2 * dp_f32 * u_cap
-    need = scratch + working
+    # one-hot expand/broadcast temporaries (ADVICE r4): the [cap, ch] /
+    # [ch, cap] one-hot + iota intermediates of the unique-broadcast loops
+    # and, in the composed kernel, the [n_rows, ch_h] head-expansion
+    # one-hots — live alongside the working set and previously uncounted
+    ch = next(d for d in (256, 128, 64, 32, 16, 8) if u_cap % d == 0)
+    ch_h = 256 if hot_n >= 256 else hot_n
+    onehot = 4 * (2 * 2 * cap * ch + 2 * (u_cap + pc + pn + cap) * ch_h)
+    need = scratch + working + onehot
     if need > _RESIDENT_VMEM_BYTES:
         kind = "composed dedup+resident" if hot_n else "dedup"
         raise ValueError(
@@ -1379,8 +1391,10 @@ def _dedup_resident_kernel(
     for c0 in range(0, UC, CH):
         j = jax.lax.broadcasted_iota(jnp.int32, (cap, CH), 1) + c0
         h = (j == uidx[:, None]).astype(f32)
+        # static value slice (c0/CH are trace-time ints): Mosaic TC has no
+        # dynamic_slice lowering for VALUES (refs use pl.ds); lax.slice does
         acc = acc + jax.lax.dot_general(
-            h, jax.lax.dynamic_slice(u_vals, (c0, 0), (CH, dp)),
+            h, jax.lax.slice(u_vals, (c0, 0), (c0 + CH, dp)),
             (((1,), (0,)), ((), ())), preferred_element_type=f32)
     is_dedup = uidx[:, None] < UC
 
@@ -1418,15 +1432,17 @@ def _dedup_resident_kernel(
     p_buf[slot] = (pv - lr * dq).reshape(p_buf[slot].shape).astype(p_buf.dtype)
 
     # ---- merged updates of the unique rows (one-hot transpose) -----------
-    d_u = jnp.zeros((UC, dp), f32)
+    # chunkwise transpose-accumulate, assembled with a static concatenate:
+    # dynamic_update_slice on a VALUE has no Mosaic TC lowering
+    d_u_chunks = []
     for c0 in range(0, UC, CH):
         jt = jax.lax.broadcasted_iota(jnp.int32, (CH, cap), 0) + c0
         ht = (jt == uidx[None, :]).astype(f32)
-        d_u = jax.lax.dynamic_update_slice(
-            d_u,
+        d_u_chunks.append(
             jax.lax.dot_general(ht, du_flat, (((1,), (0,)), ((), ())),
-                                preferred_element_type=f32),
-            (c0, 0))
+                                preferred_element_type=f32))
+    d_u = (jnp.concatenate(d_u_chunks, axis=0) if len(d_u_chunks) > 1
+           else d_u_chunks[0])
     new_u_vals = u_vals - lr * d_u
     u_uniq[slot] = new_u_vals.reshape(u_uniq[slot].shape).astype(u_uniq.dtype)
 

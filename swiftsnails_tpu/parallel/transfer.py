@@ -393,6 +393,155 @@ def push_collective_bucketed(
     return TableState(table=table, slots=slots), dropped
 
 
+# ------------------------------------------------- dedup'd packed planes ---
+#
+# The single-chip headline lever (the dedup kernels' one-DMA-per-distinct-row
+# treatment, ops/fused_sgns.py) translated to the collective grouped plane
+# (VERDICT r4 #4): each DATA shard builds a shard-local static unique list of
+# its row ids, so the `model` psum on pull and the `data` all_gather on push
+# carry ``u_cap`` merged rows instead of the full local batch — on zipf window
+# batches that is a ~5-10x collective-traffic cut. The reference's analogous
+# dedup-before-transfer is the per-server key grouping of
+# ``src/core/parameter/global_pull_access.h:58-72`` (one request per server
+# carries each key once) and the duplicate merge of ``merge_push_value``
+# (``sparsetable.h:176-179``).
+#
+# Static-capacity contract (same as the bucketed push): a shard's DISTINCT
+# row count beyond ``u_cap`` overflows — overflow slots pull zero rows /
+# drop their gradients for the step, and the count is returned so callers
+# surface it as a metric. Semantics for in-cap rows are the DETERMINISTIC
+# merged update, identical to the plain collective plane.
+
+
+def _unique_static(rows: jax.Array, cap: int, invalid: int):
+    """Shard-local static-size dedup.
+
+    Returns ``(uniq [cap], inv [n], overflow)``: ``uniq`` holds the distinct
+    row ids in sorted order (``invalid``-padded past the distinct count),
+    ``inv[i]`` is the position of ``rows[i]`` in ``uniq`` — or ``cap`` (one
+    past the end) when that row's group overflowed — and ``overflow`` counts
+    the distinct rows that did not fit.
+    """
+    n = rows.shape[0]
+    order = jnp.argsort(rows)
+    sorted_rows = rows[order]
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_rows[1:] != sorted_rows[:-1]])
+    grp = jnp.cumsum(is_first) - 1  # unique-group index per sorted position
+    n_uniq = grp[-1] + 1
+    uniq = jnp.full((cap,), invalid, rows.dtype).at[
+        jnp.where(grp < cap, grp, cap)
+    ].set(sorted_rows, mode="drop")
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.where(grp < cap, grp, cap).astype(jnp.int32))
+    overflow = jnp.maximum(n_uniq - cap, 0)
+    return uniq, inv, overflow
+
+
+def pull_collective_packed_dedup(
+    mesh: Mesh, state, rows: jax.Array, u_cap: int
+):
+    """Dedup'd sharded packed gather (pull protocol over a unique list).
+
+    Returns ``(vals [N, S, 128], (uniq, inv), overflow)``; overflowed slots
+    pull zeros. ``(uniq, inv)`` is the shard-local unique index (data-axis
+    sharded) — pass it to :func:`push_collective_packed_dedup` for the same
+    ``rows`` to skip the duplicate sort there and avoid double-counting the
+    overflow metric.
+    """
+    from swiftsnails_tpu.parallel.store import PackedTableState, pull_packed
+
+    per = _rows_per_shard(state.capacity, mesh)
+    invalid = state.capacity
+
+    def local_pull(table_shard, rows_local):
+        uniq, inv, overflow = _unique_static(rows_local, u_cap, invalid)
+        m = lax.axis_index(MODEL_AXIS)
+        local_ids = uniq - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        shard_state = PackedTableState(table=table_shard, slots={})
+        vals = pull_packed(shard_state, jnp.where(owned, local_ids, 0))
+        vals = jnp.where(owned[:, None, None], vals, 0)
+        vals = lax.psum(vals, MODEL_AXIS)  # [u_cap, S, L] assembled rows
+        # expand unique rows back to their slots; overflow slots (inv ==
+        # u_cap) read the appended zero row
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((1,) + vals.shape[1:], vals.dtype)])
+        out = vals.at[inv].get(mode="promise_in_bounds")
+        return out, uniq, inv, lax.psum(overflow, DATA_AXIS)
+
+    fn = shard_map(
+        local_pull,
+        mesh=mesh,
+        in_specs=(P(MODEL_AXIS, None, None), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS), P(DATA_AXIS), P()),
+        check_vma=False,
+    )
+    vals, uniq, inv, overflow = fn(state.table, rows)
+    return vals, (uniq, inv), overflow
+
+
+def push_collective_packed_dedup(
+    mesh: Mesh,
+    state,
+    rows: jax.Array,
+    grads: jax.Array,
+    access: AccessMethod,
+    lr,
+    u_cap: int,
+    index=None,
+):
+    """Sender-dedup'd packed push: duplicates merge into the unique list
+    BEFORE the all_gather over ``data``. Returns ``(new_state, dropped)``.
+
+    ``index``: the ``(uniq, inv)`` pair a prior
+    :func:`pull_collective_packed_dedup` over the SAME ``rows`` returned —
+    skips the duplicate shard-local sort and returns ``dropped = 0`` (the
+    pull already counted those distinct-row overflow events; counting both
+    legs would double the metric)."""
+    from swiftsnails_tpu.parallel.store import PackedTableState, push_packed
+
+    per = _rows_per_shard(state.capacity, mesh)
+    slot_keys = sorted(state.slots.keys())
+    invalid = state.capacity
+
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *idx):
+        if idx:
+            uniq, inv = idx
+            overflow = jnp.int32(0)
+        else:
+            uniq, inv, overflow = _unique_static(rows_local, u_cap, invalid)
+            overflow = lax.psum(overflow, DATA_AXIS)
+        merged = jnp.zeros(
+            (u_cap,) + grads_local.shape[1:], grads_local.dtype
+        ).at[inv].add(grads_local, mode="drop")
+        rows_all = lax.all_gather(uniq, DATA_AXIS, tiled=True)
+        grads_all = lax.all_gather(merged, DATA_AXIS, tiled=True)
+        m = lax.axis_index(MODEL_AXIS)
+        local_ids = rows_all - m * per
+        owned = (local_ids >= 0) & (local_ids < per)
+        local_ids = jnp.where(owned, local_ids, per)  # unowned -> padding
+        grads_all = jnp.where(owned[:, None, None], grads_all, 0)
+        shard_state = PackedTableState(table=table_shard, slots=slot_shards)
+        new = push_packed(shard_state, local_ids, grads_all, access, lr)
+        return new.table, dict(new.slots), overflow
+
+    shard_spec = P(MODEL_AXIS, None, None)
+    idx_args = () if index is None else tuple(index)
+    idx_specs = () if index is None else (P(DATA_AXIS), P(DATA_AXIS))
+    fn = shard_map(
+        local_push,
+        mesh=mesh,
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
+                  P(DATA_AXIS), P(DATA_AXIS)) + idx_specs,
+        out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
+        check_vma=False,
+    )
+    table, slots, dropped = fn(
+        state.table, dict(state.slots), rows, grads, *idx_args)
+    return PackedTableState(table=table, slots=slots), dropped
+
+
 def push_collective_packed_bucketed(
     mesh: Mesh,
     state,
