@@ -97,6 +97,30 @@ def test_word2vec_single_device():
     run_and_check(make_trainer(mesh=None))
 
 
+def test_dedup_resident_ucap_clamp(caplog):
+    """dedup+resident with u_cap < effective hot_rows must clamp the head
+    (with a warning) and train, not raise at the first step (ADVICE r4)."""
+    import logging
+
+    import jax
+
+    tr = make_trainer(
+        mesh=None, packed="1", neg_mode="pool", pool_size="8",
+        pool_block="64", fused="1", grouped="1", dedup="1", resident="1",
+        u_cap="8", hot_rows="64", num_iters="1",
+    )
+    state = tr.init_state()
+    batch = next(iter(tr.batches()))
+    with caplog.at_level(logging.WARNING,
+                         logger="swiftsnails_tpu.models.word2vec"):
+        state, m = jax.jit(tr.train_step, donate_argnums=(0,))(
+            state, {k: jnp.asarray(v) for k, v in batch.items()},
+            jax.random.PRNGKey(0))
+    assert np.isfinite(float(m["loss"]))
+    assert any("clamping the resident head" in r.getMessage()
+               for r in caplog.records)
+
+
 def test_word2vec_sharded_mesh():
     mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
     run_and_check(make_trainer(mesh=mesh))

@@ -182,11 +182,11 @@ def resident_lab(argv=None):
     }
     in_np = rng.random((args.vocab, S, 128), dtype=np.float32)
 
-    def timeit(fn, name, batch, reps=12, pc=256, **kw):
+    def timeit(fn, name, batch, reps=12, pc=256, dtype=jnp.float32, **kw):
         cj = jnp.asarray(batch["centers"])
         xj = jnp.asarray(batch["contexts"])
-        a = jnp.asarray(in_np)
-        b = jnp.zeros((args.vocab, S, 128), jnp.float32)
+        a = jnp.asarray(in_np, dtype)
+        b = jnp.zeros((args.vocab, S, 128), dtype)
         pool = jnp.asarray(zipf((N // pc) * PN))
         try:
             a, b, loss = fn(a, b, cj, xj, pool, lr=0.025, lam=5 / PN,
@@ -240,6 +240,31 @@ def resident_lab(argv=None):
                 fused_sgns_dedup_resident_step,
                 f"dedup+res pc=256 u_cap={uc} hot={hot} (block-ordered)",
                 b_blk[256], u_cap=uc, hot_rows=hot)
+        # r5: three kernels with 3x different copies/pair measured within 7%
+        # (BENCH r5 run 1) — the bound is per-block fixed cost, not copy
+        # count. Larger blocks amortize it; bf16 halves scratch bytes.
+        results["grouped pc=512"] = timeit(
+            fused_sgns_grouped_step, "grouped pc=512 (shuffled)", b_shuf,
+            pc=512)
+        for hot in (512, 2048):
+            results[f"resident pc=512 hot={hot}"] = timeit(
+                fused_sgns_resident_step,
+                f"resident pc=512 hot={hot} (shuffled)", b_shuf, pc=512,
+                hot_rows=hot)
+        for uc, hot in ((768, 512), (1024, 1024)):
+            results[f"dedup+res pc=512 u={uc} hot={hot}"] = timeit(
+                fused_sgns_dedup_resident_step,
+                f"dedup+res pc=512 u_cap={uc} hot={hot} (block-ordered)",
+                b_blk[512], pc=512, u_cap=uc, hot_rows=hot)
+        for nm, fn2, batch2, kw in (
+            ("grouped", fused_sgns_grouped_step, b_shuf, {}),
+            ("resident hot=2048", fused_sgns_resident_step, b_shuf,
+             {"hot_rows": 2048}),
+            ("dedup+res u=512 hot=512", fused_sgns_dedup_resident_step,
+             b_blk[256], {"u_cap": 512, "hot_rows": 512}),
+        ):
+            results[f"{nm} bf16"] = timeit(
+                fn2, f"{nm} bf16", batch2, dtype=jnp.bfloat16, **kw)
     best = max(results, key=results.get)
     print(f"best: {best} ({results[best]:,.0f} words/sec)")
 
