@@ -32,6 +32,39 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
+_WAIT_CHUNK = 64
+
+
+def _wait_rows(row_ref, chunk_ref, sem, count):
+    """Retire ``count`` single-row DMA completions in ~count/chunk scalar ops.
+
+    The wait side of the DMA loops used to be one scalar op PER COPY —
+    half of the ~60ns/op scalar floor every kernel family hits
+    (docs/ARCHITECTURE.md round-5 ablation). A wait's decrement is
+    derived from its descriptor size, and completions increment the
+    shared semaphore in row-additive 32-byte granules (measured:
+    tools/sem_probe.py — 15.8x on the wait loop of a bench-shaped DMA
+    pipeline), so ONE wait on a ``ch``-row descriptor retires ``ch``
+    equal-size single-row copies at once. ``row_ref``/``chunk_ref`` must
+    match the row shape+dtype of every copy sharing ``sem`` (the step
+    wrappers enforce equal table dtypes).
+    """
+    ch = chunk_ref.shape[0]  # the chunk wait retires exactly this many rows
+    nch = count // ch
+
+    def wch(_, c):
+        pltpu.make_async_copy(chunk_ref, chunk_ref, sem).wait()
+        return c
+
+    jax.lax.fori_loop(0, nch, wch, 0)
+
+    def w(_, c):
+        pltpu.make_async_copy(row_ref, row_ref, sem).wait()
+        return c
+
+    jax.lax.fori_loop(0, count - nch * ch, w, 0)
+
+
 def _kernel(in_rows_ref, pos_rows_ref, pool_rows_ref, lr_ref,
             in_t_in, out_t_in, in_table, out_table, loss_ref,
             v_buf, u_buf, p_buf, read_sems, write_sems,
@@ -70,17 +103,11 @@ def _kernel(in_rows_ref, pos_rows_ref, pool_rows_ref, lr_ref,
 
     def wait_all(b, slot, table_dir):
         sems = read_sems if table_dir == "read" else write_sems
-
-        def w(j, _):
-            # equal-size copies share the semaphore, so each wait retires one
-            # row's worth of bytes; the (fixed, in-bounds) ref only supplies
-            # the copy size
-            pltpu.make_async_copy(
-                v_buf.at[slot, 0], v_buf.at[slot, 0], sems.at[slot]
-            ).wait()
-            return 0
-
-        jax.lax.fori_loop(0, 2 * P + PN, w, 0)
+        # equal-size copies share the semaphore; the (fixed, in-bounds)
+        # refs only supply the wait size
+        ch = min(_WAIT_CHUNK, P)
+        _wait_rows(v_buf.at[slot, 0], v_buf.at[slot, :ch],
+                   sems.at[slot], 2 * P + PN)
 
     @pl.when(i == 0)
     def _():
@@ -232,14 +259,9 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
             if read
             else nwc_ref[b] + PN + nwu_ref[b]
         )
-
-        def w(j, _):
-            pltpu.make_async_copy(
-                v_buf.at[slot, 0], v_buf.at[slot, 0], sems.at[slot]
-            ).wait()
-            return 0
-
-        jax.lax.fori_loop(0, count, w, 0)
+        wc = min(_WAIT_CHUNK, cap)
+        _wait_rows(v_buf.at[slot, 0], u_buf.at[slot, :wc],
+                   sems.at[slot], count)
 
     @pl.when(i == 0)
     def _():
@@ -351,6 +373,8 @@ def fused_sgns_grouped_step(
         raise ValueError(f"centers_per_block*2*window {cap} exceeds slot bits")
     if in_table.shape[0] > _ROW_MASK or out_table.shape[0] > _ROW_MASK:
         raise ValueError("table capacity exceeds 2^30 (row-id flag bit)")
+    if in_table.shape[1:] != out_table.shape[1:] or in_table.dtype != out_table.dtype:
+        raise ValueError("in/out tables must share row shape and dtype")
 
     # [CW, PC] orientation throughout (PC = lanes): flat slot k = c*PC + p
     flat = (
@@ -518,14 +542,9 @@ def _resident_kernel(ccold_rows_ref, ccold_slot_ref, ncc_ref, nwc_ref,
             if read
             else nwc_ref[b] + nwu_ref[b] + nwp_ref[b]
         )
-
-        def w(j, _):
-            pltpu.make_async_copy(
-                v_buf.at[slot, 0], v_buf.at[slot, 0], sems.at[slot]
-            ).wait()
-            return 0
-
-        jax.lax.fori_loop(0, count, w, 0)
+        wc = min(_WAIT_CHUNK, cap)
+        _wait_rows(v_buf.at[slot, 0], u_buf.at[slot, :wc],
+                   sems.at[slot], count)
 
     @pl.when(i == 0)
     def _():
@@ -731,6 +750,68 @@ def _check_dedup_vmem(u_cap, pc, cap, pn, row_shape, dtype, hot_n=0):
             f"ctx slots={cap}, pool={pn}); lower u_cap, hot_rows, or "
             "centers_per_block"
         )
+
+
+def dedup_prep(centers, ctxs, pc, u_cap):
+    """Per-block dedup prep for :func:`fused_sgns_dedup_step` (pure XLA).
+
+    ``centers`` [N] row ids, ``ctxs`` [N, cw] (-1 pads), block-ordered.
+    Ranks each block's distinct context rows in ASCENDING row-id order;
+    the first ``u_cap`` get unique-list slots, the rest stay per-slot
+    ("direct") copies. Returns the scalar-prefetch/BlockSpec operands of
+    the dedup kernel: ``(c_packed [N], u_list [NB, u_cap], nu [NB],
+    ctx_rows [NB, cap], ctx_slot [NB, cap], nctx_direct [NB],
+    nw_packed [NB] (direct-ctx writes | center writes << 16),
+    uidx [NB, cap], direct_real [NB, cap] f32, mask [NB, cw, pc] f32)``.
+
+    Shared by the step wrapper and ``tools/dedup_profile.py`` so the
+    profiled prologue can never drift from the shipped math; the native
+    producer's host-side prep must stay bit-identical to this function
+    (pinned by tests).
+    """
+    n, cw = ctxs.shape
+    nblocks = n // pc
+    cap = pc * cw
+    big = jnp.int32(2**31 - 1)
+    flat = (
+        ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
+    ).astype(jnp.int32)
+    valid = flat >= 0
+
+    keyed = jnp.where(valid, flat, big)
+    order = jnp.argsort(keyed, axis=1, stable=True)
+    sr = jnp.take_along_axis(keyed, order, axis=1)
+    head = jnp.concatenate(
+        [jnp.ones((nblocks, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1
+    ) & (sr != big)
+    ranks_sorted = jnp.cumsum(head, axis=1) - 1  # unique rank per sorted pos
+    rank = jnp.zeros((nblocks, cap), jnp.int32)
+    rank = rank.at[jnp.arange(nblocks)[:, None], order].set(ranks_sorted)
+    in_list = valid & (rank < u_cap)
+    direct = valid & ~in_list
+    uidx = jnp.where(in_list, rank, u_cap).astype(jnp.int32)
+
+    tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
+    u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)
+    u_list = u_list.at[jnp.arange(nblocks)[:, None], tgt].set(
+        jnp.where(head, sr, 0)
+    )[:, :u_cap]
+    nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
+
+    ctx_rows, ctx_slot, nctx_direct, nwu_direct = _cold_compact(flat, direct)
+    mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
+    direct_real = direct.astype(jnp.float32)
+
+    c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
+    c_last = _last_occurrence(c_blocks, jnp.ones_like(c_blocks, bool))
+    nwrite_c = c_last.sum(axis=1).astype(jnp.int32)
+    c_packed = (c_blocks | jnp.where(c_last, 1 << 30, 0)).reshape(-1)
+    # write-count packing: nwu_ref carries direct-ctx writes (low 16 bits)
+    # and center writes (high bits) — the wrapper's cap < 2^16 guard
+    # bounds both
+    nw_packed = (nwu_direct | (nwrite_c << 16)).astype(jnp.int32)
+    return (c_packed, u_list, nu, ctx_rows, ctx_slot, nctx_direct,
+            nw_packed, uidx, direct_real, mask)
 
 
 def _cold_compact(rows, is_cold, slot_bits=20):
@@ -973,14 +1054,9 @@ def _dedup_kernel(c_rows_ref, u_list_ref, nu_ref,
             if read
             else (nwu_ref[b] & 0xFFFF) + (nwu_ref[b] >> 16) + PN + nu_ref[b]
         )
-
-        def w(j, _):
-            pltpu.make_async_copy(
-                v_buf.at[slot, 0], v_buf.at[slot, 0], sems.at[slot]
-            ).wait()
-            return 0
-
-        jax.lax.fori_loop(0, count, w, 0)
+        wc = min(_WAIT_CHUNK, cap)
+        _wait_rows(v_buf.at[slot, 0], u_buf.at[slot, :wc],
+                   sems.at[slot], count)
 
     @pl.when(i == 0)
     def _():
@@ -1128,43 +1204,8 @@ def fused_sgns_dedup_step(
         raise ValueError("in/out tables must share row shape and dtype")
     _check_dedup_vmem(u_cap, pc, cap, pn, in_table.shape[1:], in_table.dtype)
 
-    big = jnp.int32(2**31 - 1)
-    flat = (
-        ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
-    ).astype(jnp.int32)
-    valid = flat >= 0
-
-    keyed = jnp.where(valid, flat, big)
-    order = jnp.argsort(keyed, axis=1, stable=True)
-    sr = jnp.take_along_axis(keyed, order, axis=1)
-    head = jnp.concatenate(
-        [jnp.ones((nblocks, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1
-    ) & (sr != big)
-    ranks_sorted = jnp.cumsum(head, axis=1) - 1  # unique rank per sorted pos
-    rank = jnp.zeros((nblocks, cap), jnp.int32)
-    rank = rank.at[jnp.arange(nblocks)[:, None], order].set(ranks_sorted)
-    in_list = valid & (rank < u_cap)
-    direct = valid & ~in_list
-    uidx = jnp.where(in_list, rank, u_cap).astype(jnp.int32)
-
-    tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
-    u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)
-    u_list = u_list.at[jnp.arange(nblocks)[:, None], tgt].set(
-        jnp.where(head, sr, 0)
-    )[:, :u_cap]
-    nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
-
-    ctx_rows, ctx_slot, nctx_direct, nwu_direct = _cold_compact(flat, direct)
-    mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
-    direct_real = direct.astype(jnp.float32)
-
-    c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
-    c_last = _last_occurrence(c_blocks, jnp.ones_like(c_blocks, bool))
-    nwrite_c = c_last.sum(axis=1).astype(jnp.int32)
-    c_packed = (c_blocks | jnp.where(c_last, 1 << 30, 0)).reshape(-1)
-    # write-count packing: nwu_ref carries direct-ctx writes (low 16) and
-    # center writes (high bits) — the cap < 2^16 guard above bounds both
-    nw_packed = (nwu_direct | (nwrite_c << 16)).astype(jnp.int32)
+    (c_packed, u_list, nu, ctx_rows, ctx_slot, nctx_direct, nw_packed,
+     uidx, direct_real, mask) = dedup_prep(centers, ctxs, pc, u_cap)
 
     # one-hot chunk size must DIVIDE u_cap (the ds() slices tile it exactly)
     ch = next(d for d in (256, 128, 64, 32, 16, 8) if u_cap % d == 0)
@@ -1328,14 +1369,9 @@ def _dedup_resident_kernel(
             if read
             else nwc_ref[b] + nwu_ref[b] + nwp_ref[b] + nuc_ref[b]
         )
-
-        def w(j, _):
-            pltpu.make_async_copy(
-                v_buf.at[slot, 0], v_buf.at[slot, 0], sems.at[slot]
-            ).wait()
-            return 0
-
-        jax.lax.fori_loop(0, count, w, 0)
+        wc = min(_WAIT_CHUNK, cap)
+        _wait_rows(v_buf.at[slot, 0], u_buf.at[slot, :wc],
+                   sems.at[slot], count)
 
     @pl.when(i == 0)
     def _():
@@ -1686,6 +1722,8 @@ def fused_sgns_step(
         raise ValueError(
             f"pool_rows {pool_rows.shape[0]} != nblocks*pool {nblocks * pn}"
         )
+    if in_table.shape[1:] != out_table.shape[1:] or in_table.dtype != out_table.dtype:
+        raise ValueError("in/out tables must share row shape and dtype")
     c, s, lanes = in_table.shape
     kern = functools.partial(
         _kernel, lam=lam, inv_b=1.0 / b, pairs=p, pool=pn

@@ -566,11 +566,12 @@ def test_composed_vmem_check_models_union():
     from swiftsnails_tpu.ops.fused_sgns import _check_dedup_vmem
 
     row = (8, 128)  # 4 KiB rows
-    # ~94 MiB as plain dedup: passes...
-    _check_dedup_vmem(1536, 256, 2560, 64, row, jnp.float32)
-    # ...but + the resident head buffers (~12 MiB) it must raise
+    # ~98 MiB as plain dedup: passes...
+    _check_dedup_vmem(1024, 256, 2560, 64, row, jnp.float32)
+    # ...but + the resident head buffers and head-expansion one-hots
+    # (~16 MiB) it must raise
     with pytest.raises(ValueError, match="composed"):
-        _check_dedup_vmem(1536, 256, 2560, 64, row, jnp.float32, hot_n=1536)
+        _check_dedup_vmem(1024, 256, 2560, 64, row, jnp.float32, hot_n=1024)
 
 
 def test_dedup_resident_rejects_small_u_cap():

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Where does the dedup substep's time go? prologue vs kernel.
+
+The dedup kernel moves ~3x fewer rows than grouped yet measures about
+the same words/sec — chunked waits removed the wait-loop scalar ops, so
+the remaining suspects are (a) the XLA prep prologue (argsort + cumsum +
+scatter over [nblocks, cap] inside the jitted step) and (b) the one-hot
+broadcast/accumulate compute chain. This times the full step vs a
+prologue-only jit of the identical prep math on identical batches.
+
+Run alone on the chip:  python tools/dedup_profile.py
+"""
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.ops import fused_sgns as fs
+
+    print(f"devices: {jax.devices()}", flush=True)
+
+    V, DIM, W, PC, PN, UC = 1_000_000, 200, 5, 256, 64, 384
+    S = -(-DIM // 128)
+    N = 98304  # centers per substep (the bench macro shape)
+    rng = np.random.default_rng(0)
+
+    # zipf-ish corpus -> block-ordered window batch, as the bench builds
+    ranks = rng.zipf(1.2, size=600_000).astype(np.int64)
+    ids = np.minimum(ranks - 1, V - 1).astype(np.int32)
+    from swiftsnails_tpu.data import native as nat
+
+    wp = nat.WindowPrefetcher(
+        *nat.skipgram_windows(ids, W, seed=1), batch_size=N, block=PC,
+        epochs=1, seed=1)
+    batch = next(iter(wp))
+    wp.close()
+    cj = jnp.asarray(batch["centers"])
+    xj = jnp.asarray(batch["contexts"])
+    cw = xj.shape[1]
+    pool = jnp.asarray(rng.integers(0, V, (N // PC) * PN).astype(np.int32))
+
+    a = jnp.asarray(rng.random((V, S, 128), dtype=np.float32))
+    b = jnp.zeros((V, S, 128), jnp.float32)
+
+    # ---- prologue-only jit: the SHARED prep math of the dedup wrapper ----
+    @functools.partial(jax.jit, static_argnames=("pc", "u_cap"))
+    def prologue(centers, ctxs, pc, u_cap):
+        outs = fs.dedup_prep(centers, ctxs, pc, u_cap)
+        return sum(o.astype(jnp.float32).sum() for o in outs)
+
+    def timeit(name, fn, reps=10):
+        out = fn()
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name}: {dt * 1e3:.2f} ms  ({N / dt:,.0f} words/sec-equiv)",
+              flush=True)
+        return dt
+
+    t_pro = timeit("prologue only", lambda: prologue(cj, xj, pc=PC, u_cap=UC))
+
+    state = {"a": a, "b": b}
+
+    def step_dedup():
+        state["a"], state["b"], loss = fs.fused_sgns_dedup_step(
+            state["a"], state["b"], cj, xj, pool, lr=0.025, lam=5 / PN,
+            window=W, centers_per_block=PC, pool_size=PN, u_cap=UC)
+        return loss
+
+    t_ded = timeit("dedup step (full)", step_dedup)
+
+    state = {"a": a, "b": b}
+
+    def step_grouped():
+        state["a"], state["b"], loss = fs.fused_sgns_grouped_step(
+            state["a"], state["b"], cj, xj, pool, lr=0.025, lam=5 / PN,
+            window=W, centers_per_block=PC, pool_size=PN)
+        return loss
+
+    t_grp = timeit("grouped step (full)", step_grouped)
+
+    print(f"prologue share of dedup step: {t_pro / t_ded * 100:.0f}%",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
