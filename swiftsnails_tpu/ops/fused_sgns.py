@@ -381,20 +381,13 @@ def fused_sgns_grouped_step(
         ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
     ).astype(jnp.int32)
     valid = flat >= 0
-    # compact real context slots to the front of each block's copy list
-    order = jnp.argsort(~valid, axis=1, stable=True)  # real first
-    ctx_rows = jnp.take_along_axis(flat, order, axis=1)
-    ctx_rows = jnp.where(ctx_rows >= 0, ctx_rows, 0)  # never an address
-    nctx = valid.sum(axis=1).astype(jnp.int32)
+    # compact real context slots to the front of each block's copy list,
+    # with last-occurrence write flags (under last-write-wins only the
+    # LAST write of a duplicated row within a block survives, so all
+    # others are skipped in the writeback — bit-identical result, fewer
+    # copies); one shared single-sort pass does both
+    ctx_rows, ctx_slot, nctx, nwrite_u = _cold_compact(flat, valid)
     mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
-
-    # last-occurrence flags: under last-write-wins only the LAST write of a
-    # duplicated row within a block survives, so all others are skipped in
-    # the writeback (bit-identical result, fewer copies)
-    valid_k = jnp.arange(cap)[None, :] < nctx[:, None]
-    u_last = _last_occurrence(ctx_rows, valid_k)
-    nwrite_u = (u_last & valid_k).sum(axis=1).astype(jnp.int32)
-    ctx_slot = (order | jnp.where(u_last, 1 << 20, 0)).astype(jnp.int32)
 
     c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
     c_last = _last_occurrence(c_blocks, jnp.ones_like(c_blocks, bool))
@@ -752,6 +745,83 @@ def _check_dedup_vmem(u_cap, pc, cap, pn, row_shape, dtype, hot_n=0):
         )
 
 
+# sort key for pad/non-member entries. Plain int, NOT jnp.int32(...): a
+# module-level jnp array would eagerly initialize the default backend at
+# import — on this tunnel that means grabbing the single-client TPU grant
+# before any platform pinning can run. Weak-typed int promotes to i32
+# against the i32 row arrays.
+_BIG = 2**31 - 1
+
+
+def _unique_prep(keyed, u_cap, row_mask=-1):
+    """Unique-list + overflow ("direct") prep from ONE stable variadic sort.
+
+    ``keyed`` [NB, cap] i32: sort key per slot — the row id (optionally
+    with priority bits above the id, e.g. the composed kernel's cold bit),
+    ``_BIG`` on invalid/pad slots. ``row_mask`` strips priority bits off
+    stored row ids (-1 = none). Returns ``(u_list [NB, u_cap] distinct
+    rows in key order, nu, ctx_rows [NB, cap] overflow copies compacted
+    front, ctx_slot (slot | last-occurrence << 20), nctx_direct,
+    nwu_direct, uidx [NB, cap] unique rank per original slot (sentinel
+    u_cap))``.
+
+    The previous implementation paid three [NB, cap] argsorts here (rank
+    assignment, overflow compaction, overflow last-occurrence) and the
+    prep prologue rivaled the kernel itself. One sort carrying the
+    original slots yields all three: in key order the overflow slots are
+    exactly the entries whose unique rank >= u_cap — a CONTIGUOUS run
+    between the in-list entries and the pads — so compaction is a cyclic
+    roll, and the end of each equal-key run is the highest original slot
+    (stable sort), i.e. the reference's last-write-wins flag.
+    """
+    nblocks, cap = keyed.shape
+    slots = jnp.broadcast_to(
+        jnp.arange(cap, dtype=jnp.int32)[None], (nblocks, cap))
+    sr, sslot = jax.lax.sort((keyed, slots), dimension=1, is_stable=True,
+                             num_keys=1)
+    vs = sr != _BIG
+    head = jnp.concatenate(
+        [jnp.ones((nblocks, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1
+    ) & vs
+    ranks_sorted = jnp.cumsum(head, axis=1) - 1  # unique rank per sorted pos
+    in_sorted = vs & (ranks_sorted < u_cap)
+    direct_sorted = vs & ~in_sorted
+    rows_idx = jnp.arange(nblocks)[:, None]
+    srow = sr & row_mask  # row ids with any priority bits stripped
+    # scatter back to original slot order (sslot is a permutation per block);
+    # member slots get their unique rank, overflow AND pad slots the u_cap
+    # sentinel — overflow ("direct") is then just valid & uidx == u_cap at
+    # the caller, no second scatter
+    uidx = jnp.full((nblocks, cap), u_cap, jnp.int32).at[rows_idx, sslot].set(
+        jnp.where(in_sorted, ranks_sorted, u_cap))
+
+    tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
+    u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)
+    u_list = u_list.at[rows_idx, tgt].set(
+        jnp.where(head, srow, 0)
+    )[:, :u_cap]
+    nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
+
+    # overflow compaction by cyclic roll: key order is [in-list][direct][pad]
+    n_in = in_sorted.sum(axis=1).astype(jnp.int32)
+    nctx_direct = (vs.sum(axis=1) - n_in).astype(jnp.int32)
+    last_sorted = jnp.concatenate(
+        [sr[:, :-1] != sr[:, 1:], jnp.ones((nblocks, 1), bool)], axis=1
+    ) & vs
+    nwu_direct = (last_sorted & direct_sorted).sum(axis=1).astype(jnp.int32)
+    packed_sorted = (
+        sslot | jnp.where(last_sorted, 1 << 20, 0)).astype(jnp.int32)
+    pos = jnp.arange(cap, dtype=jnp.int32)[None]
+    roll_idx = (pos + n_in[:, None]) % cap
+    ctx_rows = jnp.where(
+        pos < nctx_direct[:, None],
+        jnp.take_along_axis(
+            jnp.where(direct_sorted, srow, 0), roll_idx, axis=1),
+        0)
+    ctx_slot = jnp.take_along_axis(packed_sorted, roll_idx, axis=1)
+    return u_list, nu, ctx_rows, ctx_slot, nctx_direct, nwu_direct, uidx
+
+
 def dedup_prep(centers, ctxs, pc, u_cap):
     """Per-block dedup prep for :func:`fused_sgns_dedup_step` (pure XLA).
 
@@ -765,42 +835,21 @@ def dedup_prep(centers, ctxs, pc, u_cap):
     uidx [NB, cap], direct_real [NB, cap] f32, mask [NB, cw, pc] f32)``.
 
     Shared by the step wrapper and ``tools/dedup_profile.py`` so the
-    profiled prologue can never drift from the shipped math; the native
-    producer's host-side prep must stay bit-identical to this function
-    (pinned by tests).
+    profiled prologue can never drift from the shipped math. (If a native
+    host-side prep is ever added it must be pinned bit-identical to this
+    function by a test — none exists today.)
     """
     n, cw = ctxs.shape
     nblocks = n // pc
     cap = pc * cw
-    big = jnp.int32(2**31 - 1)
     flat = (
         ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
     ).astype(jnp.int32)
     valid = flat >= 0
-
-    keyed = jnp.where(valid, flat, big)
-    order = jnp.argsort(keyed, axis=1, stable=True)
-    sr = jnp.take_along_axis(keyed, order, axis=1)
-    head = jnp.concatenate(
-        [jnp.ones((nblocks, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1
-    ) & (sr != big)
-    ranks_sorted = jnp.cumsum(head, axis=1) - 1  # unique rank per sorted pos
-    rank = jnp.zeros((nblocks, cap), jnp.int32)
-    rank = rank.at[jnp.arange(nblocks)[:, None], order].set(ranks_sorted)
-    in_list = valid & (rank < u_cap)
-    direct = valid & ~in_list
-    uidx = jnp.where(in_list, rank, u_cap).astype(jnp.int32)
-
-    tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
-    u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)
-    u_list = u_list.at[jnp.arange(nblocks)[:, None], tgt].set(
-        jnp.where(head, sr, 0)
-    )[:, :u_cap]
-    nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
-
-    ctx_rows, ctx_slot, nctx_direct, nwu_direct = _cold_compact(flat, direct)
+    (u_list, nu, ctx_rows, ctx_slot, nctx_direct, nwu_direct,
+     uidx) = _unique_prep(jnp.where(valid, flat, _BIG), u_cap)
+    direct_real = (valid & (uidx >= u_cap)).astype(jnp.float32)
     mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
-    direct_real = direct.astype(jnp.float32)
 
     c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
     c_last = _last_occurrence(c_blocks, jnp.ones_like(c_blocks, bool))
@@ -821,17 +870,35 @@ def _cold_compact(rows, is_cold, slot_bits=20):
     (cold_rows [NB, K] — cold entries first, 0 elsewhere; packed_slot
     [NB, K] — original slot | is-last-occurrence << slot_bits; n_cold [NB];
     n_write [NB]).
+
+    ONE variadic stable sort by row id (carrying original slots) does all
+    the work: cold entries land at the front in ascending-row order (good:
+    the DMA loops then issue in ascending HBM address order), duplicate
+    rows form runs whose END is the highest original slot — exactly the
+    reference's last-write-wins flag — and non-cold/pad entries sink to
+    the back. The previous implementation spent TWO [NB, K] argsorts here
+    (slot-order compaction + a separate last-occurrence sort); prep sorts
+    were ~the whole XLA prologue of the dedup/resident steps.
+
+    Consumers depend only on the SET of (row, original slot) copies and on
+    which slot carries the write flag — both are order-invariant, so the
+    cold-list reordering (slot order -> row order) cannot change results.
     """
     nb, k = rows.shape
-    order = jnp.argsort(~is_cold, axis=1, stable=True)  # cold first
-    sorted_rows = jnp.take_along_axis(rows, order, axis=1)
-    sorted_cold = jnp.take_along_axis(is_cold, order, axis=1)
-    cold_rows = jnp.where(sorted_cold, sorted_rows, 0)
+    big = jnp.int32(2**31 - 1)
+    keyed = jnp.where(is_cold, rows, big)
+    slots = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None], (nb, k))
+    sr, sslot = jax.lax.sort((keyed, slots), dimension=1, is_stable=True,
+                             num_keys=1)
+    vs = sr != big
+    cold_rows = jnp.where(vs, sr, 0)
     n_cold = is_cold.sum(axis=1).astype(jnp.int32)
-    last = _last_occurrence(cold_rows, sorted_cold)
-    n_write = (last & sorted_cold).sum(axis=1).astype(jnp.int32)
-    packed_slot = (order | jnp.where(last, 1 << slot_bits, 0)).astype(jnp.int32)
-    return cold_rows.astype(jnp.int32), packed_slot, n_cold, n_write
+    last = jnp.concatenate(
+        [sr[:, :-1] != sr[:, 1:], jnp.ones((nb, 1), bool)], axis=1
+    ) & vs
+    n_write = last.sum(axis=1).astype(jnp.int32)
+    packed_slot = (sslot | jnp.where(last, 1 << slot_bits, 0)).astype(jnp.int32)
+    return cold_rows, packed_slot, n_cold, n_write
 
 
 @functools.partial(
@@ -1582,7 +1649,6 @@ def fused_sgns_dedup_resident_step(
     _check_dedup_vmem(u_cap, pc, cap, pn, in_table.shape[1:], in_table.dtype,
                       hot_n=hot_n)
 
-    big = jnp.int32(2**31 - 1)
     flat = (
         ctxs.reshape(nblocks, pc, cw).transpose(0, 2, 1).reshape(nblocks, cap)
     ).astype(jnp.int32)
@@ -1590,34 +1656,17 @@ def fused_sgns_dedup_resident_step(
 
     # sort key: hot rows first (cold bit above the row id), then by row —
     # distinct rows keep distinct keys, and every hot distinct row lands at
-    # a rank < hot_n <= u_cap (the correctness guarantee above)
+    # a rank < hot_n <= u_cap (the correctness guarantee above); one shared
+    # single-sort pass yields list, ranks, and overflow compaction
     cold_bit = jnp.where(flat >= hot_n, jnp.int32(1 << 30), 0)
-    keyed = jnp.where(valid, flat | cold_bit, big)
-    order = jnp.argsort(keyed, axis=1, stable=True)
-    sr = jnp.take_along_axis(keyed, order, axis=1)
-    head = jnp.concatenate(
-        [jnp.ones((nblocks, 1), bool), sr[:, 1:] != sr[:, :-1]], axis=1
-    ) & (sr != big)
-    ranks_sorted = jnp.cumsum(head, axis=1) - 1
-    rank = jnp.zeros((nblocks, cap), jnp.int32)
-    rank = rank.at[jnp.arange(nblocks)[:, None], order].set(ranks_sorted)
-    in_list = valid & (rank < u_cap)
-    direct = valid & ~in_list
-    uidx = jnp.where(in_list, rank, u_cap).astype(jnp.int32)
-
-    tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
-    u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)
-    u_list = u_list.at[jnp.arange(nblocks)[:, None], tgt].set(
-        jnp.where(head, sr & _ROW_MASK, 0)  # strip the cold sort bit
-    )[:, :u_cap]
-    nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
+    keyed = jnp.where(valid, flat | cold_bit, _BIG)
+    (u_list, nu, ctx_rows, ctx_slot, nctx_direct, nwu_direct,
+     uidx) = _unique_prep(keyed, u_cap, row_mask=_ROW_MASK)
+    direct_real = (valid & (uidx >= u_cap)).astype(jnp.float32)
     # DMA'd (cold) unique entries per block: rows >= hot_n within the list
     in_range = jnp.arange(u_cap)[None, :] < nu[:, None]
     nu_cold = (in_range & (u_list >= hot_n)).sum(axis=1).astype(jnp.int32)
-
-    ctx_rows, ctx_slot, nctx_direct, nwu_direct = _cold_compact(flat, direct)
     mask = valid.reshape(nblocks, cw, pc).astype(jnp.float32)
-    direct_real = direct.astype(jnp.float32)
 
     c_blocks = centers.astype(jnp.int32).reshape(nblocks, pc)
     c_hot = c_blocks < hot_n

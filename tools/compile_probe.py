@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Time the AOT compile of each fused-SGNS step at the bench shape.
+
+The composed kernel's first real Mosaic compile (2026-07-31) ran >15 min
+and wedged a grant window (bench.py gates it behind SSN_BENCH_COMPOSED=1
+since). This isolates COMPILE cost from run cost so the blowup can be
+bisected: the axon tunnel compiles via a chipless TpuAotCompiler, so
+``jit(...).lower(...).compile()`` exercises exactly the path the bench
+pays, without holding the device for the duration.
+
+    python tools/compile_probe.py [dedup-res|dedup|grouped|resident] ...
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.ops import fused_sgns as fs
+
+    V, DIM, W, PC, PN, UC, HOT = 1_000_000, 200, 5, 256, 64, 384, 256
+    S = -(-DIM // 128)
+    N = 8192  # centers per kernel call (bench substep shape)
+    CW = 2 * W
+
+    tab = jax.ShapeDtypeStruct((V, S, 128), jnp.float32)
+    cs = jax.ShapeDtypeStruct((N,), jnp.int32)
+    xs = jax.ShapeDtypeStruct((N, CW), jnp.int32)
+    ps = jax.ShapeDtypeStruct(((N // PC) * PN,), jnp.int32)
+    lr = jax.ShapeDtypeStruct((), jnp.float32)
+
+    base = dict(lam=5 / PN, window=W, centers_per_block=PC, pool_size=PN)
+    steps = {
+        "grouped": (fs.fused_sgns_grouped_step, base),
+        "dedup": (fs.fused_sgns_dedup_step, {**base, "u_cap": UC}),
+        "resident": (fs.fused_sgns_resident_step, {**base, "hot_rows": 2048}),
+        "dedup-res": (fs.fused_sgns_dedup_resident_step,
+                      {**base, "u_cap": UC, "hot_rows": HOT}),
+    }
+    names = sys.argv[1:] or ["grouped", "dedup", "resident", "dedup-res"]
+    for name in names:
+        fn, kw = steps[name]
+        t0 = time.perf_counter()
+        lowered = fn.lower(tab, tab, cs, xs, ps, lr, **kw)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        del compiled
+        print(f"{name}: lower {t1 - t0:.1f}s  compile {t2 - t1:.1f}s",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,32 +31,57 @@ def main():
 
     V, DIM, W, PC, PN, UC = 1_000_000, 200, 5, 256, 64, 384
     S = -(-DIM // 128)
-    N = 98304  # centers per substep (the bench macro shape)
+    # centers per KERNEL CALL — the bench substep shape (bench.py caps the
+    # grouped batch at 8192 for SMEM; the macro is 8 scanned substeps).
+    # 98304-as-one-call overflows the 1 MiB SMEM prefetch budget.
+    N = 8192
+    SPC = 8  # substeps per timed dispatch, matching STEPS_PER_CALL
     rng = np.random.default_rng(0)
 
-    # zipf-ish corpus -> block-ordered window batch, as the bench builds
-    ranks = rng.zipf(1.2, size=600_000).astype(np.int64)
+    # zipf-ish corpus -> block-ordered window macro, as the bench builds;
+    # split into SPC scanned substeps so the timed dispatch matches the
+    # trainer's macro step (single-call timings carry ~1ms tunnel dispatch)
+    ranks = rng.zipf(1.2, size=900_000).astype(np.int64)
     ids = np.minimum(ranks - 1, V - 1).astype(np.int32)
     from swiftsnails_tpu.data import native as nat
 
     wp = nat.WindowPrefetcher(
-        *nat.skipgram_windows(ids, W, seed=1), batch_size=N, block=PC,
+        *nat.skipgram_windows(ids, W, seed=1), batch_size=N * SPC, block=PC,
         epochs=1, seed=1)
     batch = next(iter(wp))
     wp.close()
-    cj = jnp.asarray(batch["centers"])
-    xj = jnp.asarray(batch["contexts"])
-    cw = xj.shape[1]
-    pool = jnp.asarray(rng.integers(0, V, (N // PC) * PN).astype(np.int32))
+    cw = batch["contexts"].shape[1]
+    cs = jnp.asarray(batch["centers"].reshape(SPC, N))
+    xs = jnp.asarray(batch["contexts"].reshape(SPC, N, cw))
+    ps = jnp.asarray(
+        rng.integers(0, V, (SPC, (N // PC) * PN)).astype(np.int32))
 
     a = jnp.asarray(rng.random((V, S, 128), dtype=np.float32))
     b = jnp.zeros((V, S, 128), jnp.float32)
 
-    # ---- prologue-only jit: the SHARED prep math of the dedup wrapper ----
+    # ---- prologue-only: the SHARED prep math, scanned like the trainer ----
     @functools.partial(jax.jit, static_argnames=("pc", "u_cap"))
-    def prologue(centers, ctxs, pc, u_cap):
-        outs = fs.dedup_prep(centers, ctxs, pc, u_cap)
-        return sum(o.astype(jnp.float32).sum() for o in outs)
+    def prologue(cs, xs, pc, u_cap):
+        def body(acc, inp):
+            c, x = inp
+            outs = fs.dedup_prep(c, x, pc, u_cap)
+            return acc + sum(o.astype(jnp.float32).sum() for o in outs), 0
+        acc, _ = jax.lax.scan(body, jnp.float32(0), (cs, xs))
+        return acc
+
+    def macro(step_fn, **kw):
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def run(a, b, cs, xs, ps):
+            def body(carry, inp):
+                a, b = carry
+                c, x, p = inp
+                a, b, loss = step_fn(
+                    a, b, c, x, p, lr=0.025, lam=5 / PN, window=W,
+                    centers_per_block=PC, pool_size=PN, **kw)
+                return (a, b), loss
+            (a, b), losses = jax.lax.scan(body, (a, b), (cs, xs, ps))
+            return a, b, losses.sum()
+        return run
 
     def timeit(name, fn, reps=10):
         out = fn()
@@ -66,34 +91,41 @@ def main():
             out = fn()
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / reps
-        print(f"{name}: {dt * 1e3:.2f} ms  ({N / dt:,.0f} words/sec-equiv)",
-              flush=True)
+        print(f"{name}: {dt * 1e3:.2f} ms/macro "
+              f"({N * SPC / dt:,.0f} words/sec-equiv)", flush=True)
         return dt
 
-    t_pro = timeit("prologue only", lambda: prologue(cj, xj, pc=PC, u_cap=UC))
+    t_pro = timeit("prologue only", lambda: prologue(cs, xs, pc=PC, u_cap=UC))
 
-    state = {"a": a, "b": b}
+    st = {}
 
-    def step_dedup():
-        state["a"], state["b"], loss = fs.fused_sgns_dedup_step(
-            state["a"], state["b"], cj, xj, pool, lr=0.025, lam=5 / PN,
-            window=W, centers_per_block=PC, pool_size=PN, u_cap=UC)
-        return loss
+    def run_macro(name, step_fn, **kw):
+        st[name] = (a.copy(), b.copy())
+        m = macro(step_fn, **kw)
 
-    t_ded = timeit("dedup step (full)", step_dedup)
+        def go():
+            na, nb, loss = m(st[name][0], st[name][1], cs, xs, ps)
+            st[name] = (na, nb)
+            return loss
 
-    state = {"a": a, "b": b}
+        return timeit(name, go)
 
-    def step_grouped():
-        state["a"], state["b"], loss = fs.fused_sgns_grouped_step(
-            state["a"], state["b"], cj, xj, pool, lr=0.025, lam=5 / PN,
-            window=W, centers_per_block=PC, pool_size=PN)
-        return loss
+    t_ded = run_macro("dedup macro", fs.fused_sgns_dedup_step, u_cap=UC)
+    t_grp = run_macro("grouped macro", fs.fused_sgns_grouped_step)
 
-    t_grp = timeit("grouped step (full)", step_grouped)
-
-    print(f"prologue share of dedup step: {t_pro / t_ded * 100:.0f}%",
+    print(f"prologue share of dedup macro: {t_pro / t_ded * 100:.0f}% "
+          f"(kernel-only implied: {N * SPC / (t_ded - t_pro):,.0f} w/s)",
           flush=True)
+
+    if "--resident" in sys.argv:
+        run_macro("resident macro", fs.fused_sgns_resident_step,
+                  hot_rows=2048)
+    if "--composed" in sys.argv:  # compile blowup suspect: time it visibly
+        t0 = time.perf_counter()
+        run_macro("composed macro", fs.fused_sgns_dedup_resident_step,
+                  u_cap=UC, hot_rows=256)
+        print(f"composed total incl. compile: {time.perf_counter() - t0:.0f}s",
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-5 measurement orchestrator: probe until the TPU grant returns, then
+# run the measurement sequence serially (one client at a time, per the
+# grant discipline in docs/ARCHITECTURE.md), logging each stage to /tmp/r5lab.
+#
+#   1. tools/dedup_profile.py --resident  (prologue share + per-kernel rates)
+#   2. bench.py                           (fresh headline artifact + cache)
+#   3. tools/kernel_lab.py --ctr --quick  (mesh CTR plane chip rate)
+#   4. tools/compile_probe.py dedup-res   (composed compile cost, sacrificial
+#                                          last: a blown compile only loses
+#                                          what is already measured)
+cd /root/repo || exit 1
+LOG=/tmp/r5lab
+mkdir -p "$LOG"
+
+# No external timeout and no kill: the child either prints PROBE quickly
+# (healthy grant) or jax itself gives up with UNAVAILABLE after its own
+# internal deadline (~20 min observed). Waiting for the child's verdict
+# leaks no TPU-grabbing processes to race the measurement stages later,
+# and never kills a client mid-init (the grant-wedging hazard in
+# docs/ARCHITECTURE.md / .claude/skills/verify).
+probe() {
+  python - <<'EOF'
+import subprocess, sys
+code = ("import jax\n"
+        "from swiftsnails_tpu.utils.platform_pin import repin_from_env\n"
+        "repin_from_env()\n"
+        "print('PROBE', len(jax.devices()))")
+child = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE,
+                         stderr=subprocess.DEVNULL, text=True)
+out, _ = child.communicate()
+sys.exit(0 if "PROBE" in (out or "") else 1)
+EOF
+}
+
+until probe; do
+  echo "$(date -u +%F,%T) grant unavailable" >> "$LOG/probe.log"
+  sleep 120
+done
+echo "$(date -u +%F,%T) grant OK" >> "$LOG/probe.log"
+
+python tools/dedup_profile.py --resident > "$LOG/profile.log" 2>&1
+echo "$(date -u +%F,%T) profile done rc=$?" >> "$LOG/probe.log"
+python bench.py > "$LOG/bench.json" 2> "$LOG/bench.err"
+echo "$(date -u +%F,%T) bench done rc=$?" >> "$LOG/probe.log"
+python tools/kernel_lab.py --ctr --quick > "$LOG/ctr.log" 2>&1
+echo "$(date -u +%F,%T) ctr done rc=$?" >> "$LOG/probe.log"
+python tools/compile_probe.py dedup-res > "$LOG/compile.log" 2>&1
+echo "$(date -u +%F,%T) compile probe done rc=$?" >> "$LOG/probe.log"

@@ -108,6 +108,16 @@ def main(argv=None):
     u_row = units["f32[2,128]"] if S == 2 else probe_unit(1, S, jnp.float32)
     print(f"row unit f32[{S},128]: {u_row}", flush=True)
 
+    if not linear:
+        # without row-additive completions the mx >= CH*u_row poll below can
+        # pass (e.g. fixed 1-per-copy increments) while the one-shot chunk
+        # descriptor's compiler-derived decrement exceeds what ever arrives
+        # — an unbounded in-kernel wait. Nothing downstream is safe to run.
+        print("=> increments are not row-additive; chunked waits are "
+              "unsound on this platform — stopping before experiment 2",
+              flush=True)
+        return
+
     # ---- 2. chunk-wait correctness (guarded) ----------------------------
     CH = 64
     V = 4096
