@@ -233,14 +233,13 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
             return 0
 
         def u_dma(k, _):
+            # two-segment copy list (_cold_compact): the first nwu entries
+            # are exactly the flagged last-occurrence writes, so the write
+            # loop is bounded by nwu and issues UNCONDITIONALLY — no
+            # ~60ns/slot branch over mostly-skipped entries
             s = ctx_slot_ref[b * cap + k]
             row = ctx_rows_ref[b * cap + k]
-            if read:
-                mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
-            else:
-                @pl.when((s >> 20) != 0)
-                def _():
-                    mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
+            mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
             return 0
 
         def p_dma(q, _):
@@ -248,7 +247,8 @@ def _grouped_kernel(c_rows_ref, ctx_rows_ref, ctx_slot_ref, nctx_ref,
             return 0
 
         jax.lax.fori_loop(0, PC, v_dma, 0)
-        jax.lax.fori_loop(0, nctx_ref[b], u_dma, 0)  # real slots only
+        # read: all real slots; write: flagged prefix only
+        jax.lax.fori_loop(0, nctx_ref[b] if read else nwu_ref[b], u_dma, 0)
         jax.lax.fori_loop(0, PN, p_dma, 0)
 
     def wait_all(b, slot, table_dir):
@@ -505,27 +505,24 @@ def _resident_kernel(ccold_rows_ref, ccold_slot_ref, ncc_ref, nwc_ref,
             return pltpu.make_async_copy(src, dst, sems.at[slot])
 
         def cold_dma(rows_ref, slot_ref, buf, table, stride):
+            # two-segment lists (_cold_compact): write loops are bounded by
+            # the flagged-write count and issue unconditionally
             def go(k, _):
                 row = rows_ref[b * stride + k]
                 sl = slot_ref[b * stride + k]
-                if read:
-                    mk(buf.at[slot, sl & _SLOT_MASK], table, row).start()
-                else:
-                    @pl.when((sl >> 20) != 0)
-                    def _():
-                        mk(buf.at[slot, sl & _SLOT_MASK], table, row).start()
+                mk(buf.at[slot, sl & _SLOT_MASK], table, row).start()
                 return 0
             return go
 
         jax.lax.fori_loop(
-            0, ncc_ref[b], cold_dma(ccold_rows_ref, ccold_slot_ref, v_buf,
-                                    in_table, PC), 0)
+            0, ncc_ref[b] if read else nwc_ref[b],
+            cold_dma(ccold_rows_ref, ccold_slot_ref, v_buf, in_table, PC), 0)
         jax.lax.fori_loop(
-            0, nctx_ref[b], cold_dma(ctx_rows_ref, ctx_slot_ref, u_buf,
-                                     out_table, cap), 0)
+            0, nctx_ref[b] if read else nwu_ref[b],
+            cold_dma(ctx_rows_ref, ctx_slot_ref, u_buf, out_table, cap), 0)
         jax.lax.fori_loop(
-            0, npc_ref[b], cold_dma(pcold_rows_ref, pcold_slot_ref, p_buf,
-                                    out_table, PN), 0)
+            0, npc_ref[b] if read else nwp_ref[b],
+            cold_dma(pcold_rows_ref, pcold_slot_ref, p_buf, out_table, PN), 0)
 
     def wait_all(b, slot, table_dir):
         read = table_dir == "read"
@@ -753,6 +750,32 @@ def _check_dedup_vmem(u_cap, pc, cap, pn, row_shape, dtype, hot_n=0):
 _BIG = 2**31 - 1
 
 
+def _two_segment_scatter(srow, sslot, select, last, slot_bits=20):
+    """Scatter sorted entries into the two-segment copy-list order.
+
+    ``srow``/``sslot`` [NB, K]: sorted row ids and their original slots;
+    ``select`` marks the entries to keep, ``last`` their run-end
+    (last-occurrence) flags. Output order: [flagged write entries][non-last
+    duplicates][zeros] — the contract every kernel write loop relies on
+    (read loops run [0, n_member), write loops [0, n_write), both
+    unconditional). Returns (rows, packed_slot, n_member, n_write).
+    """
+    nb, k = srow.shape
+    keep_last = select & last
+    n_write = keep_last.sum(axis=1).astype(jnp.int32)
+    n_member = select.sum(axis=1).astype(jnp.int32)
+    pos = jnp.where(
+        keep_last, jnp.cumsum(keep_last, axis=1) - 1,
+        n_write[:, None] + jnp.cumsum(select & ~keep_last, axis=1) - 1)
+    tgt = jnp.where(select, pos, k).astype(jnp.int32)
+    rows_idx = jnp.arange(nb)[:, None]
+    rows = jnp.zeros((nb, k + 1), jnp.int32).at[rows_idx, tgt].set(
+        jnp.where(select, srow, 0))[:, :k]
+    packed_slot = jnp.zeros((nb, k + 1), jnp.int32).at[rows_idx, tgt].set(
+        sslot | jnp.where(keep_last, 1 << slot_bits, 0))[:, :k]
+    return rows, packed_slot, n_member, n_write
+
+
 def _unique_prep(keyed, u_cap, row_mask=-1):
     """Unique-list + overflow ("direct") prep from ONE stable variadic sort.
 
@@ -802,23 +825,14 @@ def _unique_prep(keyed, u_cap, row_mask=-1):
     )[:, :u_cap]
     nu = jnp.minimum(head.sum(axis=1), u_cap).astype(jnp.int32)
 
-    # overflow compaction by cyclic roll: key order is [in-list][direct][pad]
-    n_in = in_sorted.sum(axis=1).astype(jnp.int32)
-    nctx_direct = (vs.sum(axis=1) - n_in).astype(jnp.int32)
+    # overflow compaction into the two-segment order the write loops need
+    # (see _two_segment_scatter): read loops run [0, nctx_direct), write
+    # loops [0, nwu_direct), both with unconditional issues
     last_sorted = jnp.concatenate(
         [sr[:, :-1] != sr[:, 1:], jnp.ones((nblocks, 1), bool)], axis=1
     ) & vs
-    nwu_direct = (last_sorted & direct_sorted).sum(axis=1).astype(jnp.int32)
-    packed_sorted = (
-        sslot | jnp.where(last_sorted, 1 << 20, 0)).astype(jnp.int32)
-    pos = jnp.arange(cap, dtype=jnp.int32)[None]
-    roll_idx = (pos + n_in[:, None]) % cap
-    ctx_rows = jnp.where(
-        pos < nctx_direct[:, None],
-        jnp.take_along_axis(
-            jnp.where(direct_sorted, srow, 0), roll_idx, axis=1),
-        0)
-    ctx_slot = jnp.take_along_axis(packed_sorted, roll_idx, axis=1)
+    ctx_rows, ctx_slot, nctx_direct, nwu_direct = _two_segment_scatter(
+        srow, sslot, direct_sorted, last_sorted)
     return u_list, nu, ctx_rows, ctx_slot, nctx_direct, nwu_direct, uidx
 
 
@@ -872,33 +886,36 @@ def _cold_compact(rows, is_cold, slot_bits=20):
     n_write [NB]).
 
     ONE variadic stable sort by row id (carrying original slots) does all
-    the work: cold entries land at the front in ascending-row order (good:
-    the DMA loops then issue in ascending HBM address order), duplicate
-    rows form runs whose END is the highest original slot — exactly the
-    reference's last-write-wins flag — and non-cold/pad entries sink to
-    the back. The previous implementation spent TWO [NB, K] argsorts here
-    (slot-order compaction + a separate last-occurrence sort); prep sorts
-    were ~the whole XLA prologue of the dedup/resident steps.
+    the work: duplicate rows form runs whose END is the highest original
+    slot — exactly the reference's last-write-wins flag — and non-cold/pad
+    entries sink to the back. The previous implementation spent TWO
+    [NB, K] argsorts here (slot-order compaction + a separate
+    last-occurrence sort); prep sorts were ~the whole XLA prologue of the
+    dedup/resident steps.
 
-    Consumers depend only on the SET of (row, original slot) copies and on
-    which slot carries the write flag — both are order-invariant, so the
-    cold-list reordering (slot order -> row order) cannot change results.
+    TWO-SEGMENT ORDER: the first ``n_write`` entries are exactly the
+    flagged (last-occurrence) copies, the rest of the first ``n_cold``
+    are the non-last duplicates. Kernel read loops run [0, n_cold) as
+    before; WRITE loops run [0, n_write) with an UNCONDITIONAL issue —
+    the per-entry flag branch over mostly-skipped slots was a measured
+    ~60ns/iteration of pure scalar-core waste (docs/ARCHITECTURE.md
+    round-5 ablation; ~1340 skipped iterations per grouped block at the
+    bench shape).
+
+    Consumers depend only on the SET of (row, original slot) copies and
+    on which slots carry write flags — both are order-invariant, so the
+    reordering cannot change results.
     """
     nb, k = rows.shape
-    big = jnp.int32(2**31 - 1)
-    keyed = jnp.where(is_cold, rows, big)
+    keyed = jnp.where(is_cold, rows, _BIG)
     slots = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None], (nb, k))
     sr, sslot = jax.lax.sort((keyed, slots), dimension=1, is_stable=True,
                              num_keys=1)
-    vs = sr != big
-    cold_rows = jnp.where(vs, sr, 0)
-    n_cold = is_cold.sum(axis=1).astype(jnp.int32)
+    vs = sr != _BIG
     last = jnp.concatenate(
         [sr[:, :-1] != sr[:, 1:], jnp.ones((nb, 1), bool)], axis=1
     ) & vs
-    n_write = last.sum(axis=1).astype(jnp.int32)
-    packed_slot = (sslot | jnp.where(last, 1 << slot_bits, 0)).astype(jnp.int32)
-    return cold_rows, packed_slot, n_cold, n_write
+    return _two_segment_scatter(sr, sslot, vs, last, slot_bits=slot_bits)
 
 
 @functools.partial(
@@ -1088,14 +1105,11 @@ def _dedup_kernel(c_rows_ref, u_list_ref, nu_ref,
             return 0
 
         def u_dma(k, _):  # direct (overflow) ctx slots, per-slot
+            # two-segment order (_unique_prep): write prefix is exactly the
+            # flagged last-occurrence entries — unconditional issue
             s = ctx_slot_ref[b * cap + k]
             row = ctx_rows_ref[b * cap + k]
-            if read:
-                mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
-            else:
-                @pl.when((s >> 20) != 0)
-                def _():
-                    mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
+            mk(u_buf.at[slot, s & _SLOT_MASK], out_table, row).start()
             return 0
 
         def p_dma(q, _):
@@ -1107,7 +1121,8 @@ def _dedup_kernel(c_rows_ref, u_list_ref, nu_ref,
             return 0
 
         jax.lax.fori_loop(0, PC, v_dma, 0)
-        jax.lax.fori_loop(0, nctx_ref[b], u_dma, 0)
+        jax.lax.fori_loop(
+            0, nctx_ref[b] if read else nwu_ref[b] & 0xFFFF, u_dma, 0)
         jax.lax.fori_loop(0, PN, p_dma, 0)
         jax.lax.fori_loop(0, nu_ref[b], uq_dma, 0)
 
@@ -1396,36 +1411,31 @@ def _dedup_resident_kernel(
             return pltpu.make_async_copy(src, dst, sems.at[slot])
 
         def cold_dma(rows_ref, slot_ref, buf, table, stride):
+            # two-segment lists (_cold_compact/_unique_prep): write loops
+            # are bounded by the flagged-write count, unconditional issue
             def go(k, _):
                 row = rows_ref[b * stride + k]
                 sl = slot_ref[b * stride + k]
-                if read:
-                    mk(buf.at[slot, sl & _SLOT_MASK], table, row).start()
-                else:
-                    @pl.when((sl >> 20) != 0)
-                    def _():
-                        mk(buf.at[slot, sl & _SLOT_MASK], table, row).start()
+                mk(buf.at[slot, sl & _SLOT_MASK], table, row).start()
                 return 0
             return go
 
         def uq_dma(j, _):  # one DMA per DISTINCT COLD ctx row
-            row = u_list_ref[b * UC + j]
-
-            @pl.when(row >= HOT)
-            def _():
-                mk(u_uniq.at[slot, j], out_table, row).start()
+            mk(u_uniq.at[slot, j], out_table, u_list_ref[b * UC + j]).start()
             return 0
 
         jax.lax.fori_loop(
-            0, ncc_ref[b], cold_dma(ccold_rows_ref, ccold_slot_ref, v_buf,
-                                    in_table, PC), 0)
+            0, ncc_ref[b] if read else nwc_ref[b],
+            cold_dma(ccold_rows_ref, ccold_slot_ref, v_buf, in_table, PC), 0)
         jax.lax.fori_loop(
-            0, nctx_ref[b], cold_dma(ctx_rows_ref, ctx_slot_ref, u_buf,
-                                     out_table, cap), 0)
+            0, nctx_ref[b] if read else nwu_ref[b],
+            cold_dma(ctx_rows_ref, ctx_slot_ref, u_buf, out_table, cap), 0)
         jax.lax.fori_loop(
-            0, npc_ref[b], cold_dma(pcold_rows_ref, pcold_slot_ref, p_buf,
-                                    out_table, PN), 0)
-        jax.lax.fori_loop(0, nu_ref[b], uq_dma, 0)
+            0, npc_ref[b] if read else nwp_ref[b],
+            cold_dma(pcold_rows_ref, pcold_slot_ref, p_buf, out_table, PN), 0)
+        # the hot-first sort key makes COLD uniques the [nu-nuc, nu) suffix
+        # of the list — loop exactly that range, no per-entry hot branch
+        jax.lax.fori_loop(nu_ref[b] - nuc_ref[b], nu_ref[b], uq_dma, 0)
 
     def wait_all(b, slot, table_dir):
         read = table_dir == "read"

@@ -1,0 +1,102 @@
+"""Invariants of the single-sort copy-list prep (_cold_compact/_unique_prep).
+
+The kernels' DMA loops rely on a structural contract the equivalence
+suite only checks indirectly (through final tables):
+
+- two-segment order: the first ``n_write`` entries of a compacted list
+  are EXACTLY the flagged last-occurrence copies (write loops issue
+  unconditionally over that prefix), the rest of the first ``n_member``
+  are the non-last duplicates;
+- each flagged entry carries the HIGHEST original slot of its row
+  (reference last-write-wins, sparsetable.h:176-179);
+- the (row, slot) multiset over the member prefix equals the input's
+  member slots exactly (no copy lost or invented).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from swiftsnails_tpu.ops.fused_sgns import _BIG, _cold_compact, _unique_prep
+
+
+def _check_two_segment(rows_np, member_np, out_rows, out_slot, n_m, n_w,
+                       slot_bits=20):
+    nb, k = rows_np.shape
+    for b in range(nb):
+        exp = [(int(r), int(s)) for s, (r, m) in
+               enumerate(zip(rows_np[b], member_np[b])) if m]
+        assert n_m[b] == len(exp)
+        got = [(int(out_rows[b, j]), int(out_slot[b, j]) & ((1 << slot_bits) - 1))
+               for j in range(n_m[b])]
+        assert sorted(got) == sorted(exp), f"block {b}: copy multiset drifted"
+        flags = [(int(out_slot[b, j]) >> slot_bits) & 1 for j in range(n_m[b])]
+        # two-segment: flagged prefix, unflagged suffix
+        assert flags[: n_w[b]] == [1] * int(n_w[b])
+        assert flags[n_w[b]: n_m[b]] == [0] * int(n_m[b] - n_w[b])
+        # flagged entries: one per distinct row, at that row's highest slot
+        by_row = {}
+        for r, s in exp:
+            by_row.setdefault(r, []).append(s)
+        flagged = {int(out_rows[b, j]):
+                   int(out_slot[b, j]) & ((1 << slot_bits) - 1)
+                   for j in range(n_w[b])}
+        assert set(flagged) == set(by_row)
+        for r, s in flagged.items():
+            assert s == max(by_row[r]), f"row {r}: flag not on last slot"
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_cold_compact_two_segment(seed):
+    rng = np.random.default_rng(seed)
+    nb, k = 3, 64
+    rows = rng.integers(0, 12, (nb, k)).astype(np.int32)  # dense duplicates
+    member = rng.random((nb, k)) < 0.6
+    out_rows, out_slot, n_m, n_w = (
+        np.asarray(x) for x in _cold_compact(jnp.asarray(rows),
+                                             jnp.asarray(member)))
+    _check_two_segment(rows, member, out_rows, out_slot, n_m, n_w)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_unique_prep_lists(seed):
+    rng = np.random.default_rng(seed)
+    nb, cap, u_cap = 2, 96, 16
+    rows = rng.integers(0, 24, (nb, cap)).astype(np.int32)
+    valid = rng.random((nb, cap)) < 0.8
+    keyed = jnp.asarray(np.where(valid, rows, _BIG))
+    (u_list, nu, ctx_rows, ctx_slot, nctx, nwu, uidx) = (
+        np.asarray(x) for x in _unique_prep(keyed, u_cap))
+    for b in range(nb):
+        distinct = np.unique(rows[b][valid[b]])
+        n_u = min(len(distinct), u_cap)
+        assert nu[b] == n_u
+        # unique list: first u_cap distinct rows in ascending order
+        assert list(u_list[b, :n_u]) == list(distinct[:n_u])
+        # uidx: rank for in-list slots, sentinel for overflow/pads
+        rank_of = {int(r): i for i, r in enumerate(distinct[:n_u])}
+        for s in range(cap):
+            if valid[b, s] and int(rows[b, s]) in rank_of:
+                assert uidx[b, s] == rank_of[int(rows[b, s])]
+            else:
+                assert uidx[b, s] == u_cap
+        # overflow ("direct") compacted list: two-segment over the slots
+        # whose row ranked beyond u_cap
+        direct = valid[b] & np.array(
+            [int(r) not in rank_of for r in rows[b]])
+        _check_two_segment(rows[b][None], direct[None], ctx_rows[b][None],
+                           ctx_slot[b][None], nctx[b][None], nwu[b][None])
+
+
+def test_unique_prep_row_mask_strips_priority_bits():
+    # composed-kernel usage: a cold bit above the row id orders hot rows
+    # first but must never leak into stored row ids
+    rows = np.array([[5, 1, 5, 9, 1, 3]], dtype=np.int32)
+    hot_n = 4
+    keyed = jnp.asarray(rows | np.where(rows >= hot_n, 1 << 30, 0))
+    u_list, nu, ctx_rows, ctx_slot, nctx, nwu, uidx = _unique_prep(
+        keyed, u_cap=8, row_mask=(1 << 30) - 1)
+    # hot rows (1, 3) rank first, then cold (5, 9); ids stripped of the bit
+    assert list(np.asarray(u_list)[0, : int(nu[0])]) == [1, 3, 5, 9]
+    assert int(np.asarray(ctx_rows)[0, 0]) < (1 << 30)

@@ -108,7 +108,9 @@ def main():
             st[name] = (na, nb)
             return loss
 
-        return timeit(name, go)
+        dt = timeit(name, go)
+        del st[name]  # ~2 GB HBM per kernel's table pair; don't accumulate
+        return dt
 
     t_ded = run_macro("dedup macro", fs.fused_sgns_dedup_step, u_cap=UC)
     t_grp = run_macro("grouped macro", fs.fused_sgns_grouped_step)
