@@ -376,6 +376,22 @@ def ctr_lab(argv=None):
     print(f"mesh-path overhead vs single-device plane: {t_mesh / t_small:.2f}x")
 
 
+def _compiled_collective_bytes(fn, args, op_pattern):
+    """f32 bytes moved by collectives matching ``op_pattern`` in the
+    optimized HLO of ``jit(fn)(*args)`` — the hardware-transferable traffic
+    number (ICI volume scales the same way the compiled shapes do)."""
+    import re
+
+    import jax
+
+    hlo = jax.jit(fn).lower(*args).compile().as_text()
+    total = 0
+    for m in re.finditer(r"f32\[([\d,]*)\][^\n]*(?:%s)" % op_pattern, hlo):
+        dims = [int(d) for d in m.group(1).split(",") if d]
+        total += 4 * int(np.prod(dims)) if dims else 4
+    return total
+
+
 def push_lab():
     """Gather vs owner-bucketed push on the virtual CPU mesh.
 
@@ -386,8 +402,6 @@ def push_lab():
 
         python tools/kernel_lab.py --push   # self-pins the 8-vCPU mesh
     """
-    import re
-
     from swiftsnails_tpu.utils.platform_pin import pin_cpu, repin_after_import
 
     pin_cpu(8)
@@ -414,12 +428,8 @@ def push_lab():
     grads = jax.device_put(rng.normal(size=(b, dim)).astype(np.float32), bs)
 
     def ag_bytes(fn):
-        hlo = jax.jit(fn).lower(state, rows, grads).compile().as_text()
-        total = 0
-        for m in re.finditer(r"f32\[([\d,]+)\][^\n]*all-gather", hlo):
-            dims = [int(d) for d in m.group(1).split(",") if d]
-            total += 4 * int(np.prod(dims)) if dims else 4
-        return total
+        return _compiled_collective_bytes(fn, (state, rows, grads),
+                                          "all-gather")
 
     def timeit(fn, n=30):
         f = jax.jit(fn)
@@ -444,9 +454,89 @@ def push_lab():
     print("pays for. The traffic number is the hardware-transferable result.")
 
 
+def dedup_traffic_lab():
+    """Plain vs dedup'd collective packed plane: compiled collective bytes.
+
+    The mesh dedup plane (transfer.pull/push_collective_packed_dedup) claims
+    a large ICI-traffic cut on zipf window batches; this measures it the
+    hardware-independent way (like --push): psum + all-gather bytes in the
+    optimized HLO, on rows drawn from a REAL block-ordered window batch so
+    the duplicate rate is the production one.
+
+        python tools/kernel_lab.py --dedup-traffic   # self-pins 8-vCPU mesh
+    """
+    from swiftsnails_tpu.utils.platform_pin import pin_cpu, repin_after_import
+
+    pin_cpu(8)
+
+    import jax
+    import jax.numpy as jnp
+
+    repin_after_import(8)
+
+    from swiftsnails_tpu.data import native as nat
+    from swiftsnails_tpu.parallel import SgdAccess, make_mesh
+    from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, batch_sharding
+    from swiftsnails_tpu.parallel.store import create_packed_table
+    from swiftsnails_tpu.parallel.transfer import (
+        pull_collective_packed,
+        pull_collective_packed_dedup,
+        push_collective_packed,
+        push_collective_packed_dedup,
+    )
+
+    cap, dim, n_batch, u_cap = 1 << 16, 200, 8192, 1024
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+    access = SgdAccess()
+    state = create_packed_table(cap, dim, access, mesh=mesh, seed=0)
+
+    # production-shaped rows: context ids of a block-ordered zipf window
+    # batch (adjacent windows overlap -> the duplicate rate dedup exploits)
+    rng = np.random.default_rng(0)
+    ranks = rng.zipf(1.2, size=200_000).astype(np.int64)
+    ids = np.minimum(ranks - 1, cap - 1).astype(np.int32)
+    wp = nat.WindowPrefetcher(*nat.skipgram_windows(ids, 5, seed=1),
+                              batch_size=4096, block=256, epochs=1, seed=1)
+    batch = next(iter(wp))
+    wp.close()
+    ctx = batch["contexts"].reshape(-1)
+    ctx = ctx[ctx >= 0][:n_batch]
+    rows_np = np.resize(ctx, n_batch).astype(np.int32)
+    uniq_frac = len(np.unique(rows_np)) / n_batch
+    bs = batch_sharding(mesh)
+    rows = jax.device_put(rows_np, bs)
+    grads = jax.device_put(
+        rng.normal(size=(n_batch,) + state.table.shape[1:]).astype(np.float32),
+        bs)
+
+    def coll_bytes(fn, *args):
+        return _compiled_collective_bytes(fn, args, "all-gather|all-reduce")
+
+    plain_pull = lambda s, r: pull_collective_packed(mesh, s, r)
+    plain_push = lambda s, r, g: push_collective_packed(
+        mesh, s, r, g, access, 0.1).table
+    pp = coll_bytes(plain_pull, state, rows)
+    ps = coll_bytes(plain_push, state, rows, grads)
+    print(f"window-batch rows: n={n_batch}, distinct={uniq_frac:.1%}")
+    print(f"plain collective bytes: pull={pp:,}  push={ps:,}")
+    for uc in (u_cap, 512):
+        dedup_pull = lambda s, r: pull_collective_packed_dedup(
+            mesh, s, r, uc)[0]
+        dedup_push = lambda s, r, g: push_collective_packed_dedup(
+            mesh, s, r, g, access, 0.1, uc)[0].table
+        dp = coll_bytes(dedup_pull, state, rows)
+        ds = coll_bytes(dedup_push, state, rows, grads)
+        print(f"dedup u_cap={uc}: pull={dp:,} ({pp / max(dp, 1):.2f}x less)  "
+              f"push={ds:,} ({ps / max(ds, 1):.2f}x less)")
+    print("NOTE: compiled psum/all-gather volume is the hardware-transferable")
+    print("number (ICI volume scales the same way); vCPU wall time is not.")
+
+
 if __name__ == "__main__":
     if "--push" in sys.argv:
         push_lab()
+    elif "--dedup-traffic" in sys.argv:
+        dedup_traffic_lab()
     elif "--resident" in sys.argv:
         resident_lab(sys.argv[1:])
     elif "--ctr" in sys.argv:
