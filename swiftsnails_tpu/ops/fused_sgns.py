@@ -25,6 +25,7 @@ slot wins" reference that the unit test implements.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -750,13 +751,37 @@ def _check_dedup_vmem(u_cap, pc, cap, pn, row_shape, dtype, hot_n=0):
 _BIG = 2**31 - 1
 
 
+# How prep materializes position-indexed arrays: "scatter" uses XLA
+# scatter (.at[].set with computed targets), "sort" uses one more stable
+# variadic sort keyed by the target position. Both are exact; which is
+# faster depends on how the backend lowers scatter (TPU scatters can
+# serialize) — tools/dedup_profile.py A/Bs the prologue under each.
+_PREP_IMPL = os.environ.get("SSN_PREP_IMPL", "scatter")
+
+
+def _place_by_position(tgt, k, values):
+    """Order ``values`` ([NB, K] each) by target position ``tgt`` ([NB, K],
+    ``k`` = dropped). Entries with distinct tgt < k land at index tgt;
+    positions no entry targets are 0 (scatter) or unspecified past the
+    member count (sort) — consumers never read them."""
+    nb = tgt.shape[0]
+    if _PREP_IMPL == "sort":
+        out = jax.lax.sort((tgt,) + tuple(values), dimension=1,
+                           is_stable=True, num_keys=1)[1:]
+        return tuple(out)
+    rows_idx = jnp.arange(nb)[:, None]
+    return tuple(
+        jnp.zeros((nb, k + 1), v.dtype).at[rows_idx, tgt].set(v)[:, :k]
+        for v in values)
+
+
 def _two_segment_scatter(srow, sslot, select, last, slot_bits=20):
     """Scatter sorted entries into the two-segment copy-list order.
 
     ``srow``/``sslot`` [NB, K]: sorted row ids and their original slots;
     ``select`` marks the entries to keep, ``last`` their run-end
     (last-occurrence) flags. Output order: [flagged write entries][non-last
-    duplicates][zeros] — the contract every kernel write loop relies on
+    duplicates][dropped] — the contract every kernel write loop relies on
     (read loops run [0, n_member), write loops [0, n_write), both
     unconditional). Returns (rows, packed_slot, n_member, n_write).
     """
@@ -768,11 +793,10 @@ def _two_segment_scatter(srow, sslot, select, last, slot_bits=20):
         keep_last, jnp.cumsum(keep_last, axis=1) - 1,
         n_write[:, None] + jnp.cumsum(select & ~keep_last, axis=1) - 1)
     tgt = jnp.where(select, pos, k).astype(jnp.int32)
-    rows_idx = jnp.arange(nb)[:, None]
-    rows = jnp.zeros((nb, k + 1), jnp.int32).at[rows_idx, tgt].set(
-        jnp.where(select, srow, 0))[:, :k]
-    packed_slot = jnp.zeros((nb, k + 1), jnp.int32).at[rows_idx, tgt].set(
-        sslot | jnp.where(keep_last, 1 << slot_bits, 0))[:, :k]
+    rows, packed_slot = _place_by_position(
+        tgt, k,
+        (jnp.where(select, srow, 0),
+         sslot | jnp.where(keep_last, 1 << slot_bits, 0)))
     return rows, packed_slot, n_member, n_write
 
 
@@ -811,12 +835,17 @@ def _unique_prep(keyed, u_cap, row_mask=-1):
     direct_sorted = vs & ~in_sorted
     rows_idx = jnp.arange(nblocks)[:, None]
     srow = sr & row_mask  # row ids with any priority bits stripped
-    # scatter back to original slot order (sslot is a permutation per block);
-    # member slots get their unique rank, overflow AND pad slots the u_cap
-    # sentinel — overflow ("direct") is then just valid & uidx == u_cap at
-    # the caller, no second scatter
-    uidx = jnp.full((nblocks, cap), u_cap, jnp.int32).at[rows_idx, sslot].set(
-        jnp.where(in_sorted, ranks_sorted, u_cap))
+    # back to original slot order (sslot is a permutation per block, so a
+    # stable sort keyed by it is an exact inverse): member slots get their
+    # unique rank, overflow AND pad slots the u_cap sentinel — overflow
+    # ("direct") is then just valid & uidx == u_cap at the caller
+    rank_or_sentinel = jnp.where(in_sorted, ranks_sorted, u_cap)
+    if _PREP_IMPL == "sort":
+        uidx = jax.lax.sort((sslot, rank_or_sentinel), dimension=1,
+                            is_stable=True, num_keys=1)[1]
+    else:
+        uidx = jnp.full((nblocks, cap), u_cap, jnp.int32).at[
+            rows_idx, sslot].set(rank_or_sentinel)
 
     tgt = jnp.where(head & (ranks_sorted < u_cap), ranks_sorted, u_cap)
     u_list = jnp.zeros((nblocks, u_cap + 1), jnp.int32)

@@ -399,10 +399,12 @@ def push_collective_bucketed(
 # treatment, ops/fused_sgns.py) translated to the collective grouped plane
 # (VERDICT r4 #4): each DATA shard builds a shard-local static unique list of
 # its row ids, so the `model` psum on pull and the `data` all_gather on push
-# carry ``u_cap`` merged rows instead of the full local batch — MEASURED
-# (compiled psum+all-gather bytes, `tools/kernel_lab.py --dedup-traffic`,
-# block-ordered zipf window batch at 4.9% distinct rows): 4.00x less at
-# u_cap=1024, 8.00x at u_cap=512, both pull and push. The reference's analogous
+# carry ``u_cap`` merged rows instead of the full local batch. The cut is the
+# STATIC shape ratio n_local/u_cap — verified from compiled psum+all-gather
+# bytes (`tools/kernel_lab.py --dedup-traffic`: 4.00x at u_cap=1024, 8.00x at
+# u_cap=512, both legs) — and is only real when the unique list does not
+# overflow; the same lab asserts zero overflow on a block-ordered zipf window
+# batch at the production duplicate rate (4.9% distinct). The reference's analogous
 # dedup-before-transfer is the per-server key grouping of
 # ``src/core/parameter/global_pull_access.h:58-72`` (one request per server
 # carries each key once) and the duplicate merge of ``merge_push_value``

@@ -89,6 +89,35 @@ def test_unique_prep_lists(seed):
                            ctx_slot[b][None], nctx[b][None], nwu[b][None])
 
 
+@pytest.mark.parametrize("seed", [0, 1])
+def test_prep_impls_agree(seed, monkeypatch):
+    """The scatter- and sort-based placements must agree on every entry the
+    kernels read: [0, n_member) of each list, uidx everywhere."""
+    import swiftsnails_tpu.ops.fused_sgns as fs
+
+    rng = np.random.default_rng(seed)
+    nb, cap, u_cap = 2, 80, 16
+    rows = rng.integers(0, 20, (nb, cap)).astype(np.int32)
+    valid = rng.random((nb, cap)) < 0.75
+    keyed = jnp.asarray(np.where(valid, rows, _BIG))
+
+    outs = {}
+    for impl in ("scatter", "sort"):
+        monkeypatch.setattr(fs, "_PREP_IMPL", impl)
+        outs[impl] = [np.asarray(x) for x in fs._unique_prep(keyed, u_cap)]
+    (ul_a, nu_a, cr_a, cs_a, nc_a, nw_a, ui_a) = outs["scatter"]
+    (ul_b, nu_b, cr_b, cs_b, nc_b, nw_b, ui_b) = outs["sort"]
+    np.testing.assert_array_equal(ul_a, ul_b)
+    np.testing.assert_array_equal(nu_a, nu_b)
+    np.testing.assert_array_equal(nc_a, nc_b)
+    np.testing.assert_array_equal(nw_a, nw_b)
+    np.testing.assert_array_equal(ui_a, ui_b)
+    for b in range(nb):
+        n = nc_a[b]
+        np.testing.assert_array_equal(cr_a[b, :n], cr_b[b, :n])
+        np.testing.assert_array_equal(cs_a[b, :n], cs_b[b, :n])
+
+
 def test_unique_prep_row_mask_strips_priority_bits():
     # composed-kernel usage: a cold bit above the row id orders hot rows
     # first but must never leak into stored row ids

@@ -60,14 +60,21 @@ def main():
     b = jnp.zeros((V, S, 128), jnp.float32)
 
     # ---- prologue-only: the SHARED prep math, scanned like the trainer ----
-    @functools.partial(jax.jit, static_argnames=("pc", "u_cap"))
-    def prologue(cs, xs, pc, u_cap):
-        def body(acc, inp):
-            c, x = inp
-            outs = fs.dedup_prep(c, x, pc, u_cap)
-            return acc + sum(o.astype(jnp.float32).sum() for o in outs), 0
-        acc, _ = jax.lax.scan(body, jnp.float32(0), (cs, xs))
-        return acc
+    def make_prologue():
+        # factory: a fresh function object per call gives a fresh jit cache
+        # entry, so the --ab-prep impl switch below can never be masked by
+        # a cached trace (fs._PREP_IMPL is read at trace time)
+        @functools.partial(jax.jit, static_argnames=("pc", "u_cap"))
+        def prologue(cs, xs, pc, u_cap):
+            def body(acc, inp):
+                c, x = inp
+                outs = fs.dedup_prep(c, x, pc, u_cap)
+                return acc + sum(o.astype(jnp.float32).sum() for o in outs), 0
+            acc, _ = jax.lax.scan(body, jnp.float32(0), (cs, xs))
+            return acc
+        return prologue
+
+    prologue = make_prologue()
 
     def macro(step_fn, **kw):
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -96,6 +103,19 @@ def main():
         return dt
 
     t_pro = timeit("prologue only", lambda: prologue(cs, xs, pc=PC, u_cap=UC))
+
+    if "--ab-prep" in sys.argv:
+        # A/B the prep placement impls (scatter vs sort — the TPU lowering
+        # cost of XLA scatter is the open question)
+        other = "sort" if fs._PREP_IMPL == "scatter" else "scatter"
+        saved = fs._PREP_IMPL
+        fs._PREP_IMPL = other
+        try:
+            prologue_b = make_prologue()
+            timeit(f"prologue only ({other} impl)",
+                   lambda: prologue_b(cs, xs, pc=PC, u_cap=UC))
+        finally:
+            fs._PREP_IMPL = saved
 
     st = {}
 

@@ -40,7 +40,7 @@ until probe; do
 done
 echo "$(date -u +%F,%T) grant OK" >> "$LOG/probe.log"
 
-python tools/dedup_profile.py --resident > "$LOG/profile.log" 2>&1
+python tools/dedup_profile.py --resident --ab-prep > "$LOG/profile.log" 2>&1
 echo "$(date -u +%F,%T) profile done rc=$?" >> "$LOG/probe.log"
 python bench.py > "$LOG/bench.json" 2> "$LOG/bench.err"
 echo "$(date -u +%F,%T) bench done rc=$?" >> "$LOG/probe.log"
