@@ -135,6 +135,23 @@ def main():
     t_ded = run_macro("dedup macro", fs.fused_sgns_dedup_step, u_cap=UC)
     t_grp = run_macro("grouped macro", fs.fused_sgns_grouped_step)
 
+    if "--ab-prep" in sys.argv:
+        # full-step A/B under the other impl (fresh jit via the macro()
+        # factory — same no-cached-trace requirement as the prologue A/B)
+        other = "sort" if fs._PREP_IMPL == "scatter" else "scatter"
+        saved = fs._PREP_IMPL
+        fs._PREP_IMPL = other
+        # the step fn is itself @jit: its trace cache is keyed on avals
+        # only, so without clearing it the "other" macro would inline the
+        # FIRST impl's jaxpr and time the wrong thing
+        fs.fused_sgns_dedup_step.clear_cache()
+        try:
+            run_macro(f"dedup macro ({other} impl)",
+                      fs.fused_sgns_dedup_step, u_cap=UC)
+        finally:
+            fs._PREP_IMPL = saved
+            fs.fused_sgns_dedup_step.clear_cache()
+
     print(f"prologue share of dedup macro: {t_pro / t_ded * 100:.0f}% "
           f"(kernel-only implied: {N * SPC / (t_ded - t_pro):,.0f} w/s)",
           flush=True)
