@@ -12,6 +12,7 @@ Usage::
     python -m swiftsnails_tpu train  -config train.conf [-data corpus.txt]
     python -m swiftsnails_tpu export -config train.conf -checkpoint ROOT -out vec.txt
     python -m swiftsnails_tpu models
+    python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
     python -m swiftsnails_tpu worker -config ...   # alias of train (parity)
 
 ``master`` / ``server`` are accepted for parity and explain the collapse.
@@ -85,6 +86,12 @@ def cmd_models(argv: List[str]) -> int:
     return 0
 
 
+def cmd_trace_summary(argv: List[str]) -> int:
+    from swiftsnails_tpu.telemetry.summary import main as summary_main
+
+    return summary_main(argv)
+
+
 _ROLE_NOTE = (
     "swiftsnails_tpu has no separate {role} role: the parameter table lives\n"
     "sharded across the same TPU processes that train. Run\n"
@@ -112,10 +119,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_export(rest)
         if cmd == "models":
             return cmd_models(rest)
+        if cmd == "trace-summary":
+            return cmd_trace_summary(rest)
         if cmd in ("master", "server"):
             print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
             return 0
-        print(f"unknown command {cmd!r}; try: train, export, models", file=sys.stderr)
+        print(
+            f"unknown command {cmd!r}; try: train, export, models, trace-summary",
+            file=sys.stderr,
+        )
         return 2
     except ConfigError as e:
         print(f"config error: {e}", file=sys.stderr)

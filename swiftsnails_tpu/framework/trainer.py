@@ -138,6 +138,11 @@ class _Prefetcher:
             raise StopIteration
         return item
 
+    def qsize(self) -> int:
+        """Approximate queued-batch count (telemetry gauge: a persistently
+        empty queue means the host pipeline is the bottleneck)."""
+        return self._q.qsize()
+
     def close(self):
         self._stop.set()
         # drain so the producer's pending put unblocks promptly, then reap it
@@ -174,6 +179,22 @@ class TrainLoop:
             )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
+        # telemetry is opt-in (`telemetry: 1` or a `trace_path`); when off,
+        # tracer/registry stay None and run() takes the uninstrumented branch
+        self.trace_path = cfg.get_str("trace_path", "")
+        if cfg.get_bool("telemetry", False) or self.trace_path:
+            from swiftsnails_tpu.telemetry import (
+                MetricRegistry, StdoutSummarySink, Tracer,
+            )
+
+            self.tracer = Tracer(path=self.trace_path or None)
+            sinks = [self.metrics]
+            if cfg.get_bool("telemetry_stdout", False):
+                sinks.append(StdoutSummarySink())
+            self.registry = MetricRegistry(sinks=sinks)
+        else:
+            self.tracer = None
+            self.registry = None
         self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
@@ -204,33 +225,82 @@ class TrainLoop:
         last_metrics: Dict[str, jax.Array] = {}
         depth = trainer.config.get_int("prefetch_batches", 2)
         batches = _Prefetcher(iter(trainer.batches()), depth=depth) if depth else trainer.batches()
+        tel = self.tracer
+        reg = self.registry
+        it = iter(batches)
         try:
-            for batch in batches:
-                n_items = trainer.items_per_batch(batch)
-                self.profiler.on_step(step)
-                with step_annotation(trainer.name, step):
-                    dev_batch = self._device_batch(batch)
-                    rng = jax.random.fold_in(root_rng, step)
-                    state, last_metrics = self._step_fn(state, dev_batch, rng)
-                step += 1
-                self.metrics.count(n_items)
-                if self.log_every and step % self.log_every == 0:
-                    host = {k: float(v) for k, v in last_metrics.items()}
-                    self.metrics.flush_window(step=step, **host)
-                if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
-                    self.checkpoint_fn(state, step)
-                if max_steps is not None and step >= max_steps:
-                    break
+            # hot-path contract: with telemetry off (tel is None) each step
+            # pays exactly the one flag check below — the instrumented body
+            # never runs and allocates nothing
+            if tel is None:
+                for batch in it:
+                    n_items = trainer.items_per_batch(batch)
+                    self.profiler.on_step(step)
+                    with step_annotation(trainer.name, step):
+                        dev_batch = self._device_batch(batch)
+                        rng = jax.random.fold_in(root_rng, step)
+                        state, last_metrics = self._step_fn(state, dev_batch, rng)
+                    step += 1
+                    self.metrics.count(n_items)
+                    if self.log_every and step % self.log_every == 0:
+                        host = {k: float(v) for k, v in last_metrics.items()}
+                        self.metrics.flush_window(step=step, **host)
+                    if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
+                        self.checkpoint_fn(state, step)
+                    if max_steps is not None and step >= max_steps:
+                        break
+            else:
+                while True:
+                    t_step0 = time.monotonic()
+                    with tel.span("prefetch-wait"):
+                        try:
+                            batch = next(it)
+                        except StopIteration:
+                            break
+                    n_items = trainer.items_per_batch(batch)
+                    self.profiler.on_step(step)
+                    if isinstance(batches, _Prefetcher):
+                        q_depth = batches.qsize()
+                        reg.gauge("prefetch_queue_depth").set(q_depth)
+                        tel.counter("prefetch_queue_depth", q_depth)
+                    # step_span bridges to jax.profiler.StepTraceAnnotation,
+                    # so a concurrent profile_dir capture lines device work
+                    # up with these host spans by step number
+                    with tel.step_span(trainer.name, step):
+                        with tel.span("h2d"):
+                            dev_batch = self._device_batch(batch)
+                        rng = jax.random.fold_in(root_rng, step)
+                        with tel.span("step", step=step):
+                            state, last_metrics = self._step_fn(state, dev_batch, rng)
+                    step += 1
+                    reg.counter("steps").inc()
+                    reg.counter("items").inc(n_items)
+                    reg.histogram("step_ms").observe((time.monotonic() - t_step0) * 1e3)
+                    self.metrics.count(n_items)
+                    if self.log_every and step % self.log_every == 0:
+                        with tel.span("metrics-flush"):
+                            host = {k: float(v) for k, v in last_metrics.items()}
+                            self.metrics.flush_window(step=step, **host)
+                            reg.flush(step=step)
+                    if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
+                        with tel.span("checkpoint", step=step):
+                            self.checkpoint_fn(state, step)
+                    if max_steps is not None and step >= max_steps:
+                        break
         finally:
             # an open trace must be finalized even on error/interrupt
             self.profiler.close()
             if isinstance(batches, _Prefetcher):
                 batches.close()
+            if tel is not None:
+                tel.close()
         # block so throughput/final metrics are real, then final flush
         jax.block_until_ready(jax.tree_util.tree_leaves(state))
         if step % max(self.log_every, 1) != 0 or not self.log_every:
             host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
             self.metrics.flush_window(step=step, **host)
+        if reg is not None:
+            reg.flush(step=step, final=1)
         if self.checkpoint_fn is not None:
             from swiftsnails_tpu.framework.checkpoint import wait_for_checkpoints
 

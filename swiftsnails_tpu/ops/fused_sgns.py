@@ -756,7 +756,49 @@ _BIG = 2**31 - 1
 # variadic sort keyed by the target position. Both are exact; which is
 # faster depends on how the backend lowers scatter (TPU scatters can
 # serialize) — tools/dedup_profile.py A/Bs the prologue under each.
-_PREP_IMPL = os.environ.get("SSN_PREP_IMPL", "scatter")
+_PREP_IMPLS = ("scatter", "sort")
+
+
+def _validate_prep_impl(impl: str) -> str:
+    # a typo'd env value must fail loudly, not silently fall through to
+    # scatter (ADVICE r5) — the A/B tool's whole point is knowing which ran
+    if impl not in _PREP_IMPLS:
+        raise ValueError(
+            f"SSN_PREP_IMPL must be one of {_PREP_IMPLS}, got {impl!r}")
+    return impl
+
+
+_PREP_IMPL = _validate_prep_impl(os.environ.get("SSN_PREP_IMPL", "scatter"))
+
+
+def get_prep_impl() -> str:
+    return _PREP_IMPL
+
+
+def set_prep_impl(impl: str) -> str:
+    """Switch the prep placement implementation at runtime; returns the
+    previous value (so callers can restore it in a ``finally``).
+
+    The impl is read at TRACE time, so the jit caches of every step function
+    whose jaxpr bakes it in are cleared on an actual switch — without this,
+    a cached trace would silently keep running the old impl (the failure
+    mode ``tools/dedup_profile.py`` used to hand-patch around).
+    """
+    global _PREP_IMPL
+    prev = _PREP_IMPL
+    _PREP_IMPL = _validate_prep_impl(impl)
+    if prev != _PREP_IMPL:
+        for step_fn in (
+            fused_sgns_step,
+            fused_sgns_grouped_step,
+            fused_sgns_resident_step,
+            fused_sgns_dedup_step,
+            fused_sgns_dedup_resident_step,
+        ):
+            clear = getattr(step_fn, "clear_cache", None)
+            if clear is not None:
+                clear()
+    return prev
 
 
 def _place_by_position(tgt, k, values):

@@ -27,10 +27,11 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftsnails_tpu.parallel.mesh import SEQ_AXIS
+from swiftsnails_tpu.utils.compat import shard_map
 
 _NEG_INF = -1e30
 

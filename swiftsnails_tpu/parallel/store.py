@@ -28,6 +28,7 @@ O(batch), exactly like the reference's wire protocol.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -36,6 +37,21 @@ from jax.sharding import Mesh, NamedSharding
 
 from swiftsnails_tpu.parallel.access import AccessMethod, Slots
 from swiftsnails_tpu.parallel.mesh import table_sharding
+
+
+def _scoped(name: str):
+    """Label a pull/push path for the compiled-HLO communication audit
+    (``telemetry.audit`` groups collective bytes by these ``ssn_*`` scopes)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with jax.named_scope(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
 
 
 class TableState(NamedTuple):
@@ -100,10 +116,11 @@ def pull(state: TableState, rows: jax.Array, access: Optional[AccessMethod] = No
     collectives — the entire WORKER_PULL_REQUEST round trip (§3.4 of the
     survey) in one fused op.
     """
-    vals = state.table.at[rows].get(mode="promise_in_bounds")
-    if access is not None:
-        vals = access.get_pull_value(vals)
-    return vals
+    with jax.named_scope("ssn_pull"):
+        vals = state.table.at[rows].get(mode="promise_in_bounds")
+        if access is not None:
+            vals = access.get_pull_value(vals)
+        return vals
 
 
 def merge_duplicate_rows(
@@ -176,14 +193,15 @@ def push(
     Under pjit either path compiles to the reduce/scatter collectives that
     replace every WORKER_PUSH_REQUEST (§3.4).
     """
-    if not exact:
-        fast = access.scatter_update(state.table, state.slots, rows, grads, lr)
-        if fast is not None:
-            table, slots = fast
-            return TableState(table=table, slots=slots)
-    uniq, merged = merge_duplicate_rows(rows, grads, invalid_row=state.capacity)
-    table, slots = apply_rows(state.table, state.slots, uniq, merged, access, lr)
-    return TableState(table=table, slots=slots)
+    with jax.named_scope("ssn_push"):
+        if not exact:
+            fast = access.scatter_update(state.table, state.slots, rows, grads, lr)
+            if fast is not None:
+                table, slots = fast
+                return TableState(table=table, slots=slots)
+        uniq, merged = merge_duplicate_rows(rows, grads, invalid_row=state.capacity)
+        table, slots = apply_rows(state.table, state.slots, uniq, merged, access, lr)
+        return TableState(table=table, slots=slots)
 
 
 def export_rows(state: TableState, rows: jax.Array) -> jax.Array:
@@ -279,6 +297,7 @@ def create_packed_small_table(
     return jax.jit(init, out_shardings=state_shardings)()
 
 
+@_scoped("ssn_pull_packed_small")
 def pull_packed_small(
     state: PackedTableState, rows: jax.Array, dim: int,
     block_rows: int = 512, kernel: bool = True,
@@ -307,6 +326,7 @@ def pull_packed_small(
     return vals[:, 0, :dim]
 
 
+@_scoped("ssn_push_packed_small")
 def push_packed_small(
     state: PackedTableState,
     rows: jax.Array,
@@ -482,6 +502,7 @@ def _pad_to_block(rows: jax.Array, invalid_row: int, block: int):
     ), n
 
 
+@_scoped("ssn_pull_packed")
 def pull_packed(state: PackedTableState, rows: jax.Array,
                 block_rows: int = 512) -> jax.Array:
     """Gather packed rows -> [N, S, 128] (pull protocol, DMA kernel on TPU)."""
@@ -494,6 +515,7 @@ def pull_packed(state: PackedTableState, rows: jax.Array,
     return state.table.at[rows].get(mode="promise_in_bounds")
 
 
+@_scoped("ssn_push_packed")
 def push_packed(
     state: PackedTableState,
     rows: jax.Array,

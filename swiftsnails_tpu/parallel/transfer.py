@@ -31,8 +31,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from swiftsnails_tpu.utils.compat import shard_map
 
 from swiftsnails_tpu.parallel.access import AccessMethod
 from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -102,7 +103,8 @@ def pull_collective(mesh: Mesh, state: TableState, rows: jax.Array) -> jax.Array
         out_specs=P(DATA_AXIS, None),
         check_vma=False,
     )
-    return fn(state.table, rows)
+    with jax.named_scope("ssn_pull_collective"):
+        return fn(state.table, rows)
 
 
 def push_collective(
@@ -145,7 +147,8 @@ def push_collective(
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
         check_vma=False,
     )
-    table, slots = fn(state.table, dict(state.slots), rows, grads)
+    with jax.named_scope("ssn_push_collective"):
+        table, slots = fn(state.table, dict(state.slots), rows, grads)
     return TableState(table=table, slots=slots)
 
 
@@ -181,7 +184,8 @@ def pull_collective_packed(mesh: Mesh, state, rows: jax.Array) -> jax.Array:
         out_specs=P(DATA_AXIS, None, None),
         check_vma=False,
     )
-    return fn(state.table, rows)
+    with jax.named_scope("ssn_pull_collective_packed"):
+        return fn(state.table, rows)
 
 
 def push_collective_packed(
@@ -219,7 +223,8 @@ def push_collective_packed(
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
         check_vma=False,
     )
-    table, slots = fn(state.table, dict(state.slots), rows, grads)
+    with jax.named_scope("ssn_push_collective_packed"):
+        table, slots = fn(state.table, dict(state.slots), rows, grads)
     return PackedTableState(table=table, slots=slots)
 
 
@@ -277,7 +282,8 @@ def pull_collective_packed_small(
         out_specs=P(DATA_AXIS, None),
         check_vma=False,
     )
-    return fn(state.table, rows)
+    with jax.named_scope("ssn_pull_collective_packed_small"):
+        return fn(state.table, rows)
 
 
 def push_collective_packed_small(
@@ -318,7 +324,8 @@ def push_collective_packed_small(
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
         check_vma=False,
     )
-    table, slots = fn(state.table, dict(state.slots), rows, grads)
+    with jax.named_scope("ssn_push_collective_packed_small"):
+        table, slots = fn(state.table, dict(state.slots), rows, grads)
     return PackedTableState(table=table, slots=slots)
 
 
@@ -389,7 +396,8 @@ def push_collective_bucketed(
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
         check_vma=False,
     )
-    table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
+    with jax.named_scope("ssn_push_collective_bucketed"):
+        table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
     return TableState(table=table, slots=slots), dropped
 
 
@@ -481,7 +489,8 @@ def pull_collective_packed_dedup(
         out_specs=(P(DATA_AXIS, None, None), P(DATA_AXIS), P(DATA_AXIS), P()),
         check_vma=False,
     )
-    vals, uniq, inv, overflow = fn(state.table, rows)
+    with jax.named_scope("ssn_pull_collective_packed_dedup"):
+        vals, uniq, inv, overflow = fn(state.table, rows)
     return vals, (uniq, inv), overflow
 
 
@@ -541,8 +550,9 @@ def push_collective_packed_dedup(
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
         check_vma=False,
     )
-    table, slots, dropped = fn(
-        state.table, dict(state.slots), rows, grads, *idx_args)
+    with jax.named_scope("ssn_push_collective_packed_dedup"):
+        table, slots, dropped = fn(
+            state.table, dict(state.slots), rows, grads, *idx_args)
     return PackedTableState(table=table, slots=slots), dropped
 
 
@@ -589,5 +599,6 @@ def push_collective_packed_bucketed(
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
         check_vma=False,
     )
-    table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
+    with jax.named_scope("ssn_push_collective_packed_bucketed"):
+        table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
     return PackedTableState(table=table, slots=slots), dropped

@@ -1,0 +1,56 @@
+"""Unified telemetry: span tracing, metric registry, communication audit.
+
+Three legs, one subsystem (the observability the reference never had —
+SURVEY §5 lists glog lines and a chrono ``Timer`` as its entire surface):
+
+* :mod:`~swiftsnails_tpu.telemetry.tracer` — host-side nestable spans with
+  Chrome trace-event export, bridged to ``jax.profiler`` step annotations;
+* :mod:`~swiftsnails_tpu.telemetry.registry` — named counters / gauges /
+  histograms flushed through pluggable sinks
+  (:class:`~swiftsnails_tpu.utils.metrics.MetricsLogger` is the JSONL sink;
+  :class:`StdoutSummarySink` the terminal one);
+* :mod:`~swiftsnails_tpu.telemetry.audit` — per-collective op counts/bytes
+  and cost/memory analysis from a step function's optimized HLO, sync and
+  async collective forms alike.
+
+Off by default: the TrainLoop only constructs these when the ``telemetry``
+or ``trace_path`` config keys are set, and its hot path pays one
+enabled-flag check otherwise.
+"""
+
+from swiftsnails_tpu.telemetry.audit import (
+    audit_compiled,
+    audit_step,
+    collective_bytes,
+    collective_stats,
+    compiled_collective_bytes,
+)
+from swiftsnails_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    StdoutSummarySink,
+)
+from swiftsnails_tpu.telemetry.summary import summarize_file
+from swiftsnails_tpu.telemetry.tracer import Tracer
+
+# the JSONL sink IS the existing MetricsLogger (same ``log``/``close``
+# surface) — imported under the sink name so call sites read as intended
+from swiftsnails_tpu.utils.metrics import MetricsLogger as JsonlSink
+
+__all__ = [
+    "Tracer",
+    "MetricRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlSink",
+    "StdoutSummarySink",
+    "audit_compiled",
+    "audit_step",
+    "collective_bytes",
+    "collective_stats",
+    "compiled_collective_bytes",
+    "summarize_file",
+]

@@ -1,0 +1,192 @@
+"""Compiled-HLO communication audit.
+
+Deterministic, hardware-independent accounting of a jitted step function's
+collective traffic: per-collective op counts and bytes from the optimized
+HLO text, plus compiler cost/memory analysis. This is the measurement the
+labs already trusted ("compiled psum/all-gather volume transfers to
+hardware; vCPU wall time does not" — ``tools/kernel_lab.py``), promoted to
+a library and fixed to recognize ASYNC collective forms: XLA may emit
+``all-gather-start``/``all-gather-done`` pairs instead of the sync op on
+some backend/flag combinations, and the old anchor (``all-gather(``)
+silently reported 0 bytes for those (ADVICE r5).
+
+Parsing contract: only DEFINING instructions are counted (``= shape op(``) —
+a loose match would also count every consumer line naming the collective's
+result — and ``-done`` halves of async pairs never match (the op name must
+be followed by ``(`` or ``-start(``). For async starts that define a tuple,
+the traffic-carrying shape is taken as the largest tuple element (the
+result; operand aliases and ``u32[]`` context scalars are smaller).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+# collective op families, longest-prefix first so e.g. "all-gather" never
+# swallows "all-to-all"'s hyphenated cousins
+COLLECTIVE_OPS = (
+    "all-reduce-scatter",  # historical alias, keep before all-reduce
+    "reduce-scatter",
+    "all-reduce",
+    "all-gather",
+    "ragged-all-to-all",
+    "all-to-all",
+    "collective-broadcast",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# defining instruction: "<name> = <shape> <op>[-start](", where <shape> is a
+# single "dtype[dims]{layout}" or a tuple "(shape, shape, ...)"
+_DEFINING_RE = re.compile(
+    r"=\s+(?P<shape>\([^=]*?\)|[a-z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(?P<op>%s)(?P<start>-start)?\(" % "|".join(COLLECTIVE_OPS)
+)
+_SHAPE_ATOM_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_SCOPE_RE = re.compile(r"(ssn_[\w\-.]+)")
+
+
+def _atom_bytes(dtype: str, dims: str) -> int:
+    size = _DTYPE_BYTES.get(dtype)
+    if size is None:  # token/opaque/tuple-in-tuple: carries no payload here
+        return 0
+    shape = [int(d) for d in dims.split(",") if d]
+    return size * (int(np.prod(shape)) if shape else 1)
+
+
+def _shape_bytes(shape: str) -> int:
+    """Bytes of the traffic-carrying result shape (largest tuple element)."""
+    atoms = _SHAPE_ATOM_RE.findall(shape)
+    if not atoms:
+        return 0
+    return max(_atom_bytes(dt, dims) for dt, dims in atoms)
+
+
+def collective_stats(hlo_text: str) -> Dict:
+    """Per-collective counts/bytes (sync and async forms) from HLO text.
+
+    Returns ``{"ops": {op: {"count", "bytes"}}, "total_bytes", "by_scope"}``
+    where ``op`` is the base HLO name (``-start`` folded in) and
+    ``by_scope`` groups bytes under any ``ssn_*`` label found in the
+    instruction's ``op_name`` metadata (see the ``jax.named_scope`` labels
+    in ``parallel/transfer.py`` / ``parallel/store.py``).
+    """
+    ops: Dict[str, Dict[str, int]] = {}
+    by_scope: Dict[str, int] = {}
+    total = 0
+    for line in hlo_text.splitlines():
+        m = _DEFINING_RE.search(line)
+        if m is None:
+            continue
+        nbytes = _shape_bytes(m.group("shape"))
+        op = m.group("op")
+        entry = ops.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += nbytes
+        total += nbytes
+        name_m = _OP_NAME_RE.search(line)
+        if name_m:
+            scope_m = _SCOPE_RE.search(name_m.group(1))
+            if scope_m:
+                scope = scope_m.group(1)
+                by_scope[scope] = by_scope.get(scope, 0) + nbytes
+    return {"ops": ops, "total_bytes": total, "by_scope": by_scope}
+
+
+def collective_bytes(hlo_text: str, op_pattern: Optional[str] = None) -> int:
+    """Total bytes moved by collectives whose BASE op name matches
+    ``op_pattern`` (regex, fullmatch; ``None`` = every collective). Async
+    ``-start`` forms count under their base name."""
+    stats = collective_stats(hlo_text)
+    if op_pattern is None:
+        return stats["total_bytes"]
+    pat = re.compile(op_pattern)
+    return sum(
+        entry["bytes"]
+        for op, entry in stats["ops"].items()
+        if pat.fullmatch(op)
+    )
+
+
+def _normalize_cost(cost) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` returns a dict or a 1-list of dicts
+    depending on jax version; keep the headline keys only."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    out = {}
+    for key in ("flops", "bytes accessed", "transcendentals"):
+        if key in cost:
+            out[key.replace(" ", "_")] = float(cost[key])
+    return out
+
+
+_MEMORY_ATTRS = (
+    "peak_memory_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def _normalize_memory(mem) -> Dict[str, int]:
+    out = {}
+    for attr in _MEMORY_ATTRS:
+        v = getattr(mem, attr, None)
+        if v is not None:
+            out[attr] = int(v)
+    return out
+
+
+def audit_compiled(compiled) -> Dict:
+    """Audit an already-compiled executable (``jit(f).lower(...).compile()``)."""
+    report = collective_stats(compiled.as_text())
+    try:
+        report["cost"] = _normalize_cost(compiled.cost_analysis())
+    except Exception as e:  # some backends don't implement it
+        report["cost"] = {"error": str(e)}
+    try:
+        report["memory"] = _normalize_memory(compiled.memory_analysis())
+    except Exception as e:
+        report["memory"] = {"error": str(e)}
+    return report
+
+
+def audit_step(fn, *args, **kwargs) -> Dict:
+    """Lower+compile ``fn(*args, **kwargs)`` and audit the optimized HLO.
+
+    ``fn`` may be a plain callable or an existing ``jax.jit`` wrapper (it is
+    lowered as-is when it already has ``.lower``). Compilation only — nothing
+    executes, so donated/sharded arguments are safe to pass.
+    """
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return audit_compiled(jitted.lower(*args, **kwargs).compile())
+
+
+def compiled_collective_bytes(fn, args: Sequence, op_pattern: str) -> int:
+    """Bytes moved by collectives matching ``op_pattern`` in the optimized
+    HLO of ``jit(fn)(*args)`` — the hardware-transferable traffic number
+    (ICI volume scales the same way the compiled shapes do). Recognizes both
+    sync (``all-gather(``) and async (``all-gather-start(``) forms; pass the
+    base op names, e.g. ``"all-gather|all-reduce"``.
+    """
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    hlo = jitted.lower(*args).compile().as_text()
+    return collective_bytes(hlo, op_pattern)

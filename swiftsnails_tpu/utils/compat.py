@@ -1,0 +1,34 @@
+"""JAX version compatibility shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to ``jax.shard_map``
+and renamed its replication-check knob (``check_rep`` -> ``check_vma``) along
+the way — and the two moves did not happen in the same release. Every
+collective plane in :mod:`swiftsnails_tpu.parallel` calls the wrapper below
+with the modern keyword; it lands on whichever implementation and keyword the
+installed jax provides.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # modern jax: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _CHECK_KW = (
+        "check_vma"
+        if "check_vma" in inspect.signature(_shard_map).parameters
+        else "check_rep"
+    )
+except (TypeError, ValueError):  # unintrospectable wrapper: assume modern
+    _CHECK_KW = "check_vma"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **{_CHECK_KW: check_vma},
+    )

@@ -1,0 +1,121 @@
+"""utils/metrics.py (MetricsLogger JSONL sink) + telemetry metric registry."""
+
+import json
+
+import pytest
+
+from swiftsnails_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    StdoutSummarySink,
+)
+from swiftsnails_tpu.utils.metrics import MetricsLogger
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_metrics_logger_jsonl_records(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsLogger(path=path) as m:
+        m.log({"step": 1, "loss": 0.5})
+        m.log({"step": 2, "loss": 0.25, "ts": 123.0})  # explicit ts kept
+    recs = read_jsonl(path)
+    assert [r["step"] for r in recs] == [1, 2]
+    assert "ts" in recs[0]  # stamped when absent
+    assert recs[1]["ts"] == 123.0
+    # keys are sorted so the JSONL diffs stably
+    assert list(recs[0]) == sorted(recs[0])
+
+
+def test_metrics_logger_window_throughput(monkeypatch):
+    import swiftsnails_tpu.utils.metrics as um
+
+    clock = [100.0]
+    monkeypatch.setattr(um.time, "monotonic", lambda: clock[0])
+    records = []
+
+    class Sink:
+        def write(self, line):
+            records.append(json.loads(line))
+
+    m = MetricsLogger(stream=Sink())
+    m.count(30)
+    m.count(10)
+    clock[0] = 104.0  # 40 items over 4 seconds
+    rec = m.flush_window(step=7)
+    assert rec["items"] == 40
+    assert rec["seconds"] == pytest.approx(4.0)
+    assert rec["items_per_sec"] == pytest.approx(10.0)
+    assert rec["step"] == 7
+    # the window resets: immediate reflush reports zero items
+    clock[0] = 106.0
+    rec2 = m.flush_window()
+    assert rec2["items"] == 0 and rec2["seconds"] == pytest.approx(2.0)
+    assert records[0]["items"] == 40
+
+
+def test_metrics_logger_close_reopen_appends(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(path=path)
+    m.log({"run": 1})
+    m.close()
+    m.close()  # idempotent
+    m.log({"run": "post-close"})  # file sink gone; must not raise
+    m2 = MetricsLogger(path=path)  # append mode: run 1 survives
+    m2.log({"run": 2})
+    m2.close()
+    assert [r["run"] for r in read_jsonl(path)] == [1, 2]
+
+
+def test_registry_instruments():
+    reg = MetricRegistry()
+    c = reg.counter("steps")
+    c.inc()
+    c.inc(4)
+    assert reg.counter("steps") is c  # get-or-create
+    reg.gauge("depth").set(3)
+    h = reg.histogram("lat_ms")
+    for v in (2.0, 4.0, 6.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["steps"] == 5
+    assert snap["depth"] == 3
+    assert snap["lat_ms.count"] == 3
+    assert snap["lat_ms.mean"] == pytest.approx(4.0)
+    assert snap["lat_ms.min"] == 2.0 and snap["lat_ms.max"] == 6.0
+    assert snap["lat_ms.p50"] == 4.0
+
+
+def test_registry_flushes_to_metrics_logger_and_stdout(tmp_path, capsys):
+    """MetricsLogger plugs into the registry as the JSONL sink unchanged;
+    the stdout-summary sink renders the same record beside it."""
+    path = str(tmp_path / "m.jsonl")
+    jsonl = MetricsLogger(path=path)
+    reg = MetricRegistry(sinks=[jsonl, StdoutSummarySink()])
+    reg.counter("items").inc(128)
+    reg.gauge("queue").set(2)
+    rec = reg.flush(step=10)
+    reg.close()
+    assert rec["items"] == 128 and rec["step"] == 10
+    recs = read_jsonl(path)
+    assert recs[0]["items"] == 128 and recs[0]["queue"] == 2
+    out = capsys.readouterr().out
+    assert "items=128" in out and "step=10" in out
+
+
+def test_histogram_empty_summary():
+    assert Histogram("x").summary() == {"count": 0}
+
+
+def test_counter_gauge_standalone():
+    c = Counter("n")
+    c.inc(2.5)
+    assert c.value == 2.5
+    g = Gauge("g")
+    g.set(7)
+    assert g.value == 7.0

@@ -129,3 +129,29 @@ def test_unique_prep_row_mask_strips_priority_bits():
     # hot rows (1, 3) rank first, then cold (5, 9); ids stripped of the bit
     assert list(np.asarray(u_list)[0, : int(nu[0])]) == [1, 3, 5, 9]
     assert int(np.asarray(ctx_rows)[0, 0]) < (1 << 30)
+
+
+def test_set_prep_impl_validates_and_switches():
+    """set_prep_impl: rejects unknown impls, switches + restores, and the
+    env-read validation path rejects typos instead of silently scattering."""
+    import swiftsnails_tpu.ops.fused_sgns as fs
+
+    with pytest.raises(ValueError, match="SSN_PREP_IMPL"):
+        fs.set_prep_impl("sorted")  # typo must not fall through to scatter
+    with pytest.raises(ValueError, match="SSN_PREP_IMPL"):
+        fs._validate_prep_impl("scater")
+
+    start = fs.get_prep_impl()
+    other = "sort" if start == "scatter" else "scatter"
+    prev = fs.set_prep_impl(other)
+    try:
+        assert prev == start
+        assert fs.get_prep_impl() == other
+        # the switched impl actually drives _place_by_position
+        rows = np.array([[3, 1, 2, 0]], dtype=np.int32)
+        vals = (jnp.asarray([[10, 11, 12, 13]], dtype=jnp.int32),)
+        (out,) = fs._place_by_position(jnp.asarray(rows), 4, vals)
+        np.testing.assert_array_equal(np.asarray(out), [[13, 11, 12, 10]])
+    finally:
+        fs.set_prep_impl(start)
+    assert fs.get_prep_impl() == start

@@ -1,0 +1,85 @@
+"""utils/profiling.py: StepProfiler window logic (trace calls stubbed)."""
+
+import pytest
+
+import jax
+
+from swiftsnails_tpu.utils.config import Config
+from swiftsnails_tpu.utils.profiling import StepProfiler, step_annotation
+
+
+@pytest.fixture
+def trace_calls(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, "start_trace", lambda d: calls.append(("start", d))
+    )
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: calls.append(("stop", None))
+    )
+    return calls
+
+
+def make_profiler(**keys):
+    return StepProfiler(Config(keys))
+
+
+def test_disabled_without_profile_dir(trace_calls):
+    p = make_profiler()
+    assert not p.enabled
+    for s in range(30):
+        p.on_step(s)
+    p.close()
+    assert trace_calls == []
+
+
+@pytest.mark.parametrize("window", ["10", "20,10", "a,b", "5;"])
+def test_window_parsing_rejects_malformed(window):
+    with pytest.raises(ValueError):
+        make_profiler(profile_dir="/tmp/x", profile_steps=window)
+
+
+def test_window_parsing_accepts_semicolon():
+    p = make_profiler(profile_dir="/tmp/x", profile_steps="3;7")
+    assert (p.start_step, p.stop_step) == (3, 7)
+
+
+def test_trace_window_start_stop(trace_calls):
+    p = make_profiler(profile_dir="/tmp/t", profile_steps="2,4")
+    for s in range(6):
+        p.on_step(s)
+    assert trace_calls == [("start", "/tmp/t"), ("stop", None)]
+    # one-shot: a later step in range must not restart
+    p.on_step(3)
+    assert len(trace_calls) == 2
+
+
+def test_resume_past_window_start(trace_calls):
+    """A resumed run entering mid-window still captures (>= not ==)."""
+    p = make_profiler(profile_dir="/tmp/t", profile_steps="10,20")
+    for s in range(15, 25):
+        p.on_step(s)
+    assert trace_calls == [("start", "/tmp/t"), ("stop", None)]
+
+
+def test_resume_past_window_end_never_starts(trace_calls):
+    p = make_profiler(profile_dir="/tmp/t", profile_steps="10,20")
+    for s in range(20, 30):
+        p.on_step(s)
+    assert trace_calls == []
+
+
+def test_close_finalizes_open_trace(trace_calls):
+    """Interrupt inside the window: close() must stop the open trace."""
+    p = make_profiler(profile_dir="/tmp/t", profile_steps="2,100")
+    p.on_step(2)
+    assert trace_calls == [("start", "/tmp/t")]
+    p.close()
+    assert trace_calls == [("start", "/tmp/t"), ("stop", None)]
+    p.close()  # idempotent
+    assert len(trace_calls) == 2
+
+
+def test_step_annotation_runs():
+    with step_annotation("unit", 3):
+        pass

@@ -63,7 +63,7 @@ def main():
     def make_prologue():
         # factory: a fresh function object per call gives a fresh jit cache
         # entry, so the --ab-prep impl switch below can never be masked by
-        # a cached trace (fs._PREP_IMPL is read at trace time)
+        # a cached trace (the prep impl is read at trace time)
         @functools.partial(jax.jit, static_argnames=("pc", "u_cap"))
         def prologue(cs, xs, pc, u_cap):
             def body(acc, inp):
@@ -106,16 +106,17 @@ def main():
 
     if "--ab-prep" in sys.argv:
         # A/B the prep placement impls (scatter vs sort — the TPU lowering
-        # cost of XLA scatter is the open question)
-        other = "sort" if fs._PREP_IMPL == "scatter" else "scatter"
-        saved = fs._PREP_IMPL
-        fs._PREP_IMPL = other
+        # cost of XLA scatter is the open question). set_prep_impl clears
+        # the affected jit caches itself; the fresh prologue factory below
+        # only exists because `prologue` is jitted here, not in fused_sgns.
+        other = "sort" if fs.get_prep_impl() == "scatter" else "scatter"
+        saved = fs.set_prep_impl(other)
         try:
             prologue_b = make_prologue()
             timeit(f"prologue only ({other} impl)",
                    lambda: prologue_b(cs, xs, pc=PC, u_cap=UC))
         finally:
-            fs._PREP_IMPL = saved
+            fs.set_prep_impl(saved)
 
     st = {}
 
@@ -136,21 +137,17 @@ def main():
     t_grp = run_macro("grouped macro", fs.fused_sgns_grouped_step)
 
     if "--ab-prep" in sys.argv:
-        # full-step A/B under the other impl (fresh jit via the macro()
-        # factory — same no-cached-trace requirement as the prologue A/B)
-        other = "sort" if fs._PREP_IMPL == "scatter" else "scatter"
-        saved = fs._PREP_IMPL
-        fs._PREP_IMPL = other
-        # the step fn is itself @jit: its trace cache is keyed on avals
-        # only, so without clearing it the "other" macro would inline the
-        # FIRST impl's jaxpr and time the wrong thing
-        fs.fused_sgns_dedup_step.clear_cache()
+        # full-step A/B under the other impl. The step fn is itself @jit
+        # with an aval-keyed trace cache; set_prep_impl clears it on switch
+        # (both directions), so the "other" macro can never inline the
+        # first impl's jaxpr and time the wrong thing.
+        other = "sort" if fs.get_prep_impl() == "scatter" else "scatter"
+        saved = fs.set_prep_impl(other)
         try:
             run_macro(f"dedup macro ({other} impl)",
                       fs.fused_sgns_dedup_step, u_cap=UC)
         finally:
-            fs._PREP_IMPL = saved
-            fs.fused_sgns_dedup_step.clear_cache()
+            fs.set_prep_impl(saved)
 
     print(f"prologue share of dedup macro: {t_pro / t_ded * 100:.0f}% "
           f"(kernel-only implied: {N * SPC / (t_ded - t_pro):,.0f} w/s)",

@@ -377,23 +377,18 @@ def ctr_lab(argv=None):
 
 
 def _compiled_collective_bytes(fn, args, op_pattern):
-    """f32 bytes moved by collectives matching ``op_pattern`` in the
-    optimized HLO of ``jit(fn)(*args)`` — the hardware-transferable traffic
-    number (ICI volume scales the same way the compiled shapes do)."""
-    import re
+    """Bytes moved by collectives matching ``op_pattern`` in the optimized
+    HLO of ``jit(fn)(*args)`` — the hardware-transferable traffic number.
 
-    import jax
+    Single implementation: ``swiftsnails_tpu.telemetry.audit`` (imported
+    lazily — the labs pin the platform before jax loads). The audit parser
+    recognizes async collective pairs (``all-gather-start``/``-done``) that
+    the old f32-anchored regex here silently missed (ADVICE r5), so a
+    backend that emits async collectives no longer reports 0 bytes.
+    """
+    from swiftsnails_tpu.telemetry.audit import compiled_collective_bytes
 
-    hlo = jax.jit(fn).lower(*args).compile().as_text()
-    total = 0
-    # anchor to the DEFINING instruction ("= f32[...] op-name(") — a loose
-    # match would also count every consumer line that names the collective's
-    # result as an operand, and the -done half of async pairs
-    for m in re.finditer(
-            r"= f32\[([\d,]*)\][^\n]*? (?:%s)\(" % op_pattern, hlo):
-        dims = [int(d) for d in m.group(1).split(",") if d]
-        total += 4 * int(np.prod(dims)) if dims else 4
-    return total
+    return compiled_collective_bytes(fn, args, op_pattern)
 
 
 def push_lab():

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Per-span time breakdown of a telemetry trace or metrics JSONL file.
+
+Renders the artifact a training run writes when ``trace_path`` (Chrome
+trace-event JSON — also loadable in chrome://tracing / ui.perfetto.dev) or
+``metrics_path`` (JSONL) is set, as a terminal table: per-span count,
+total/mean/min/max time, and share of the traced wall-clock.
+
+    python tools/trace_summary.py RUN_TRACE.json
+    python -m swiftsnails_tpu trace-summary RUN_TRACE.json   # same thing
+
+No accelerator or jax import involved — safe anywhere.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.telemetry.summary import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())
