@@ -76,13 +76,36 @@ BASELINE_RUNS = 3  # median-of-N C-loop baseline (VERDICT r2 weak #1)
 
 _T0 = time.monotonic()
 
-# Last successful on-chip result, written after every good run. If the
+# Last successful on-chip result. Since the flight-recorder PR this file is
+# a DERIVED VIEW regenerated from the run ledger (RUN_LEDGER.jsonl, the
+# append-only source of truth — the round-5 lesson: the single cache file
+# was lost in a workspace restart and had to be hand-reconstructed). If the
 # accelerator grant is unavailable at measurement time (a wedged grant can
 # persist for hours — see docs/ARCHITECTURE.md), the bench emits this cached
 # result VISIBLY FLAGGED ("cached": true + the live error) instead of 0.0:
 # a real prior measurement with provenance beats erasing it with a zero.
 LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "BENCH_LAST_GOOD.json")
+LEDGER_PATH = os.environ.get(
+    "SSN_LEDGER_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "RUN_LEDGER.jsonl"),
+)
+
+
+def _ledger():
+    """The run ledger (lazy import: keeps bench importable stdlib-light)."""
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    return Ledger(LEDGER_PATH)
+
+
+def _ledger_event(kind, record):
+    """Best-effort ledger append: record-keeping never kills the bench."""
+    try:
+        return _ledger().append(kind, record)
+    except Exception as e:
+        print(f"bench: ledger append failed: {e}", file=sys.stderr)
+        return None
 
 # Shared mutable result state: the main thread fills it in; the watchdog
 # thread (GIL-serialized) reads it to emit the best result obtained so far.
@@ -106,6 +129,8 @@ _state = {
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
     "comm_audit": {},  # name -> compiled-HLO communication audit (telemetry)
+    "goodput": {},  # name -> MFU / roofline block (telemetry.goodput)
+    "device_kind": None,  # jax device_kind once the accelerator is live
     "errors": [],
 }
 # divergence guard on the held-out eval loss: a path whose loss exceeds the
@@ -205,6 +230,7 @@ def _result_json(extra_error=None):
             "platform": _state["platform"],
             "at_scale": _state["at_scale"],
             "comm_audit": _state["comm_audit"],
+            "goodput": _state["goodput"],
             "copies_per_pair": {
                 k: _finite(v, 3) for k, v in _state["copies_per_pair"].items()
             },
@@ -251,6 +277,7 @@ def probe_accelerator():
         "ds = jax.devices()\n"
         "print(f'PROBE {len(ds)} {ds[0].platform}', flush=True)\n"
     )
+    t_probe0 = time.monotonic()
     try:
         child = subprocess.Popen(
             [sys.executable, "-c", code],
@@ -261,22 +288,39 @@ def probe_accelerator():
         )
         out, err = child.communicate(timeout=PROBE_DEADLINE_S)
     except subprocess.TimeoutExpired:
-        _state["errors"].append(
+        msg = (
             f"accelerator grant unavailable: probe exceeded {PROBE_DEADLINE_S}s "
             "(child abandoned, not killed, to avoid wedging the grant)"
         )
+        _state["errors"].append(msg)
+        # the structured outage record that used to be a hand-written
+        # docs/OUTAGE_*.txt line — ledger-report renders the history
+        _ledger_event("outage", {
+            "probe_duration_s": round(time.monotonic() - t_probe0, 1),
+            "rc": None,  # abandoned, never reaped
+            "error": msg,
+        })
         return None
     except OSError as e:
         _state["errors"].append(f"probe spawn failed: {e}")
+        _ledger_event("outage", {
+            "probe_duration_s": round(time.monotonic() - t_probe0, 1),
+            "rc": None,
+            "error": f"probe spawn failed: {e}",
+        })
         return None
     for line in out.splitlines():
         if line.startswith("PROBE "):
             _, n, platform = line.split()
             return int(n), platform
     tail = (err or out).strip().splitlines()[-3:]
-    _state["errors"].append(
-        f"probe exited rc={child.returncode} without a device: {' | '.join(tail)}"
-    )
+    msg = f"probe exited rc={child.returncode} without a device: {' | '.join(tail)}"
+    _state["errors"].append(msg)
+    _ledger_event("outage", {
+        "probe_duration_s": round(time.monotonic() - t_probe0, 1),
+        "rc": child.returncode,
+        "error": msg,
+    })
     return None
 
 
@@ -369,6 +413,7 @@ def _measure_tpu_config(counts, batches, pairs_per_token, overrides,
         _ = float(m["loss"])  # forces the whole donated-state chain
         return time.perf_counter() - t0
 
+    audit_report = None
     if audit_key is not None:
         # compiled-HLO communication audit of this exact step function
         # (collective op counts/bytes + cost/memory analysis). Compile-only
@@ -382,9 +427,9 @@ def _measure_tpu_config(counts, batches, pairs_per_token, overrides,
             try:
                 from swiftsnails_tpu.telemetry.audit import audit_step
 
-                report = audit_step(
+                audit_report = audit_step(
                     step, state, dev_batches[0], jax.random.fold_in(rng, 0))
-                _state["comm_audit"][audit_key] = _compact_audit(report)
+                _state["comm_audit"][audit_key] = _compact_audit(audit_report)
             except Exception as e:
                 _state["errors"].append(
                     f"{audit_key} communication audit failed: {e}")
@@ -407,9 +452,39 @@ def _measure_tpu_config(counts, batches, pairs_per_token, overrides,
     dt_ub = t_long / MEASURE_STEPS
     dt = dt_diff if (0.2 * dt_ub) < dt_diff <= dt_ub else dt_ub
     if grouped:  # one batch row = one corpus word
-        return centers_per_macro / dt, quality, spread
-    pairs_per_sec = STEPS_PER_CALL * BATCH / dt
-    return pairs_per_sec / pairs_per_token, quality, spread
+        words_per_macro = centers_per_macro
+        wps = centers_per_macro / dt
+    else:
+        pairs_per_sec = STEPS_PER_CALL * BATCH / dt
+        words_per_macro = STEPS_PER_CALL * BATCH / pairs_per_token
+        wps = pairs_per_sec / pairs_per_token
+    if audit_report is not None and audit_key is not None:
+        # hardware-utilization block: the audit gives FLOPs/bytes of one
+        # macro-step dispatch; dt is its measured duration — MFU and the
+        # words/sec-vs-roofline ratio follow (telemetry.goodput)
+        try:
+            from swiftsnails_tpu.telemetry.goodput import (
+                goodput_report, peaks_for,
+            )
+
+            if _state["device_kind"] is None:
+                _state["device_kind"] = getattr(
+                    jax.devices()[0], "device_kind", _state["platform"])
+            g = goodput_report(
+                audit=audit_report, steps=1, items=int(words_per_macro),
+                step_seconds=dt, peaks=peaks_for(_state["device_kind"]),
+            )
+            _state["goodput"][audit_key] = {
+                k: (_finite(v, 6) if isinstance(v, float) else v)
+                for k, v in g.items()
+                if k in ("mfu", "vs_roofline", "items_per_sec",
+                         "roofline_items_per_sec", "roofline_step_seconds",
+                         "step_seconds", "flops_per_step",
+                         "hbm_bytes_per_step", "collective_bytes_per_step")
+            }
+        except Exception as e:
+            _state["errors"].append(f"{audit_key} goodput failed: {e}")
+    return wps, quality, spread
 
 
 _EVAL = {}  # fixed held-out (centers, contexts, negs), built once
@@ -1150,35 +1225,58 @@ def main():
 
 
 def _save_last_good():
-    """Cache this run for the outage fallback — only if it's a VALID headline
+    """Record this run in the ledger; regenerate the last-good derived view.
+
+    Every completed run appends a ``bench`` record to the durable ledger
+    (source of truth — survives the workspace restarts that erased round 5's
+    artifact). The record is flagged ``cacheable`` only for a VALID headline
     run: real accelerator, full-size workload (never SSN_BENCH_SMALL), and
     every path ATTEMPTED (a budget-truncated run must not overwrite a
     complete one; a path that ran and failed is recorded in errors and does
     not block the cache — its absence from ``paths`` plus the error IS the
-    result)."""
+    result). ``BENCH_LAST_GOOD.json`` is then regenerated from the newest
+    cacheable record — a derived view, atomically written.
+    """
     # fused-dedup-res is expected only when its gate is on (see
     # measure_tpu_paths) — a default run must still be cacheable
     expected_paths = {"dense", "packed+pool", "fused-hogwild", "fused-grouped",
                       "fused-resident", "fused-dedup"}
     if os.environ.get("SSN_BENCH_COMPOSED") == "1":
         expected_paths.add("fused-dedup-res")
-    if (
+    payload = json.loads(_result_json())
+    # a fresh measured run is by definition not a reconstruction — clear
+    # any inherited flag so the caveat dies with the first real overwrite
+    payload["reconstructed"] = False
+    payload["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    cacheable = not (
         _SMALL
         or _state["best"] <= 0
         or _state["platform"] == "cpu"
         or not expected_paths.issubset(_state["attempted"])
-    ):
-        return
+    )
     try:
-        payload = json.loads(_result_json())
-        # a fresh measured run is by definition not a reconstruction — clear
-        # any inherited flag so the caveat dies with the first real overwrite
-        payload["reconstructed"] = False
-        payload["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-        with open(LAST_GOOD_PATH, "w") as f:
-            json.dump(payload, f)
-    except OSError as e:
-        print(f"bench: could not save last-good result: {e}", file=sys.stderr)
+        from swiftsnails_tpu.telemetry.ledger import (
+            config_hash, derive_last_good, env_fingerprint,
+        )
+
+        ledger = _ledger()
+        ledger.append(
+            "bench",
+            {
+                "payload": payload,
+                "cacheable": cacheable,
+                "config_hash": config_hash(payload.get("config", {})),
+                "device_kind": _state["device_kind"],
+            },
+            env=env_fingerprint(),  # devices via probe; never re-query here
+        )
+        if cacheable:
+            written, reason = derive_last_good(ledger, LAST_GOOD_PATH)
+            if written is None:
+                print(f"bench: last-good view not regenerated: {reason}",
+                      file=sys.stderr)
+    except Exception as e:
+        print(f"bench: could not record run in ledger: {e}", file=sys.stderr)
 
 
 def _emit_cached_fallback() -> bool:
@@ -1194,10 +1292,29 @@ def _emit_cached_fallback() -> bool:
     favor of nothing; consumers that need freshness must check "cached".
     """
     global _emitted
-    try:
-        with open(LAST_GOOD_PATH) as f:
-            cached = json.load(f)
-    except (OSError, ValueError):
+    from swiftsnails_tpu.telemetry.ledger import load_bench_cache
+
+    cached, cache_err = load_bench_cache(LAST_GOOD_PATH)
+    if cached is None:
+        if os.path.exists(LAST_GOOD_PATH):
+            # a partial/unparseable cache is itself a recordable failure —
+            # a ledger event + error, never a crash or silent garbage emit
+            _state["errors"].append(f"last-good cache rejected: {cache_err}")
+            _ledger_event("cache_error", {"path": LAST_GOOD_PATH,
+                                          "error": cache_err})
+        # the ledger outlives the derived view: try to regenerate the cache
+        # from the newest cacheable bench record before giving up
+        try:
+            from swiftsnails_tpu.telemetry.ledger import derive_last_good
+
+            regenerated, reason = derive_last_good(_ledger(), LAST_GOOD_PATH)
+            if regenerated is not None:
+                _state["errors"].append(
+                    "last-good cache regenerated from the run ledger")
+                cached = regenerated
+        except Exception as e:
+            print(f"bench: cache regeneration failed: {e}", file=sys.stderr)
+    if cached is None:
         return False
     current_config = json.loads(_result_json())["config"]
     if cached.get("config") != current_config:
@@ -1226,11 +1343,34 @@ def _emit_cached_fallback() -> bool:
         cached["vs_baseline_pinned"] = round(cached["value"] / pinned_8, 3)
         cached["baseline_words_per_sec_8node_pinned"] = pinned_8
         cached["baseline_pinned_at"] = pinned.get("calibrated_at")
+    # structured last-outage summary from the ledger (replaces the free-text
+    # OUTAGE_*.txt bookkeeping): when it happened, how long the probe hung,
+    # and how many outages the ledger has seen
+    try:
+        from swiftsnails_tpu.telemetry.ledger import outage_summary
+
+        last_outage = outage_summary(_ledger())
+    except Exception:
+        last_outage = None
+    outage_errors = []
+    if last_outage is not None:
+        cached["last_outage"] = last_outage
+        outage_errors.append(
+            "last outage at {at}: probe {dur}s rc={rc} "
+            "({n} outages recorded in the ledger)".format(
+                at=last_outage.get("at"),
+                dur=last_outage.get("probe_duration_s"),
+                rc=last_outage.get("rc"),
+                n=last_outage.get("outages_recorded"),
+            )
+        )
     # keep the cached run's own caveats AND add the live outage error
-    cached["errors"] = list(cached.get("errors", [])) + list(_state["errors"]) + [
-        "accelerator unavailable NOW; value above is the last successful "
-        "on-chip measurement (see cache_measured_at), not a fresh run"
-    ]
+    cached["errors"] = (
+        list(cached.get("errors", [])) + list(_state["errors"]) + outage_errors + [
+            "accelerator unavailable NOW; value above is the last successful "
+            "on-chip measurement (see cache_measured_at), not a fresh run"
+        ]
+    )
     with _emit_lock:
         if _emitted:
             return True

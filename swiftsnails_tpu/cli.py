@@ -13,6 +13,8 @@ Usage::
     python -m swiftsnails_tpu export -config train.conf -checkpoint ROOT -out vec.txt
     python -m swiftsnails_tpu models
     python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
+    python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
+    python -m swiftsnails_tpu ledger-report --check-regression 10   # bench gate
     python -m swiftsnails_tpu worker -config ...   # alias of train (parity)
 
 ``master`` / ``server`` are accepted for parity and explain the collapse.
@@ -92,6 +94,12 @@ def cmd_trace_summary(argv: List[str]) -> int:
     return summary_main(argv)
 
 
+def cmd_ledger_report(argv: List[str]) -> int:
+    from swiftsnails_tpu.telemetry.ledger import main as ledger_main
+
+    return ledger_main(argv)
+
+
 _ROLE_NOTE = (
     "swiftsnails_tpu has no separate {role} role: the parameter table lives\n"
     "sharded across the same TPU processes that train. Run\n"
@@ -121,11 +129,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_models(rest)
         if cmd == "trace-summary":
             return cmd_trace_summary(rest)
+        if cmd == "ledger-report":
+            return cmd_ledger_report(rest)
         if cmd in ("master", "server"):
             print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
             return 0
         print(
-            f"unknown command {cmd!r}; try: train, export, models, trace-summary",
+            f"unknown command {cmd!r}; try: train, export, models, "
+            "trace-summary, ledger-report",
             file=sys.stderr,
         )
         return 2

@@ -180,21 +180,45 @@ class TrainLoop:
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
         # telemetry is opt-in (`telemetry: 1` or a `trace_path`); when off,
-        # tracer/registry stay None and run() takes the uninstrumented branch
+        # tracer/registry/black-box/ledger stay None and run() takes the
+        # uninstrumented branch
         self.trace_path = cfg.get_str("trace_path", "")
         if cfg.get_bool("telemetry", False) or self.trace_path:
             from swiftsnails_tpu.telemetry import (
-                MetricRegistry, StdoutSummarySink, Tracer,
+                BlackBox, Ledger, MetricRegistry, StdoutSummarySink, Tracer,
             )
+            from swiftsnails_tpu.telemetry.ledger import config_hash
 
             self.tracer = Tracer(path=self.trace_path or None)
             sinks = [self.metrics]
             if cfg.get_bool("telemetry_stdout", False):
                 sinks.append(StdoutSummarySink())
             self.registry = MetricRegistry(sinks=sinks)
+            ledger_path = cfg.get_str("ledger_path", "")
+            self.ledger = Ledger(ledger_path) if ledger_path else None
+            self.config_hash = config_hash(cfg.as_dict())
+            bb_steps = cfg.get_int("blackbox_steps", 32)
+            if bb_steps > 0:
+                self.blackbox = BlackBox(
+                    capacity=bb_steps,
+                    directory=cfg.get_str("blackbox_dir", "blackbox"),
+                    ledger=self.ledger,
+                    context={"model": trainer.name,
+                             "config_hash": self.config_hash},
+                )
+            else:
+                self.blackbox = None
+            # goodput needs one compile-only audit of the step function; a
+            # second lowering of the same shapes, so gateable independently
+            self._want_audit = cfg.get_bool("goodput", True)
         else:
             self.tracer = None
             self.registry = None
+            self.blackbox = None
+            self.ledger = None
+            self.config_hash = None
+            self._want_audit = False
+        self._audit_report = None
         self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
@@ -223,10 +247,14 @@ class TrainLoop:
                 step = restored_step
         root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
+        total_items = 0
         depth = trainer.config.get_int("prefetch_batches", 2)
         batches = _Prefetcher(iter(trainer.batches()), depth=depth) if depth else trainer.batches()
         tel = self.tracer
         reg = self.registry
+        bb = self.blackbox
+        if bb is not None:
+            bb.install_signal_handler(tracer=tel)
         it = iter(batches)
         try:
             # hot-path contract: with telemetry off (tel is None) each step
@@ -270,23 +298,43 @@ class TrainLoop:
                         with tel.span("h2d"):
                             dev_batch = self._device_batch(batch)
                         rng = jax.random.fold_in(root_rng, step)
+                        if self._want_audit and self._audit_report is None:
+                            # compile-only HLO audit of this exact step fn
+                            # (shapes only — safe before the donated call);
+                            # feeds the goodput block's FLOP/byte numerators
+                            self._audit_report = self._audit_step_fn(
+                                state, dev_batch, rng)
                         with tel.span("step", step=step):
                             state, last_metrics = self._step_fn(state, dev_batch, rng)
                     step += 1
+                    total_items += n_items
                     reg.counter("steps").inc()
                     reg.counter("items").inc(n_items)
-                    reg.histogram("step_ms").observe((time.monotonic() - t_step0) * 1e3)
+                    step_ms = (time.monotonic() - t_step0) * 1e3
+                    reg.histogram("step_ms").observe(step_ms)
+                    if bb is not None:
+                        bb.record_step(step, step_ms=step_ms, items=n_items)
                     self.metrics.count(n_items)
                     if self.log_every and step % self.log_every == 0:
                         with tel.span("metrics-flush"):
                             host = {k: float(v) for k, v in last_metrics.items()}
                             self.metrics.flush_window(step=step, **host)
                             reg.flush(step=step)
+                            if bb is not None:
+                                bb.record_metrics(step, host)
+                                if bb.nonfinite(host):
+                                    bb.dump("nan-loss", tracer=tel)
                     if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
                         with tel.span("checkpoint", step=step):
                             self.checkpoint_fn(state, step)
                     if max_steps is not None and step >= max_steps:
                         break
+        except BaseException as e:
+            # the flight-recorder moment: a failing run must leave a
+            # post-mortem artifact (ring of recent steps + spans) behind
+            if bb is not None:
+                bb.dump("exception", exc=e, tracer=tel)
+            raise
         finally:
             # an open trace must be finalized even on error/interrupt
             self.profiler.close()
@@ -294,15 +342,83 @@ class TrainLoop:
                 batches.close()
             if tel is not None:
                 tel.close()
+            if bb is not None:
+                bb.uninstall_signal_handler()
         # block so throughput/final metrics are real, then final flush
         jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        host = {}
         if step % max(self.log_every, 1) != 0 or not self.log_every:
             host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
             self.metrics.flush_window(step=step, **host)
+        elif bb is not None and last_metrics:
+            host = {k: float(v) for k, v in last_metrics.items()}
+        if bb is not None and host:
+            bb.record_metrics(step, host)
+            if bb.nonfinite(host):
+                bb.dump("nan-loss", tracer=tel)
         if reg is not None:
             reg.flush(step=step, final=1)
+        if tel is not None:
+            self._finalize_run_record(step, total_items, host)
         if self.checkpoint_fn is not None:
             from swiftsnails_tpu.framework.checkpoint import wait_for_checkpoints
 
             wait_for_checkpoints()
         return state
+
+    # -- goodput + ledger finalization (telemetry-only paths) --------------
+
+    def _audit_step_fn(self, state, dev_batch, rng):
+        """Compile-only HLO audit of the jitted step (never executes it);
+        any failure costs only the goodput FLOP numbers, never the run."""
+        try:
+            from swiftsnails_tpu.telemetry.audit import audit_step
+
+            return audit_step(self._step_fn, state, dev_batch, rng)
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _finalize_run_record(self, steps: int, items: int, final_metrics) -> None:
+        """Emit the goodput block to the metrics sink and, when a
+        ``ledger_path`` is configured, append the durable run record."""
+        try:
+            from swiftsnails_tpu.telemetry.goodput import (
+                goodput_report, peaks_from_config,
+            )
+            from swiftsnails_tpu.telemetry.ledger import env_fingerprint
+
+            devs = jax.devices()
+            mesh = self.trainer.mesh
+            n_chips = mesh.size if mesh is not None else 1
+            audit = self._audit_report
+            if audit is not None and "error" in audit:
+                audit = None
+            report = goodput_report(
+                events=self.tracer.events(),
+                audit=audit,
+                steps=steps,
+                items=items,
+                peaks=peaks_from_config(
+                    self.trainer.config, getattr(devs[0], "device_kind", None)
+                ),
+                n_chips=n_chips,
+            )
+            self.metrics.log({"goodput": report, "step": steps})
+            if self.ledger is not None:
+                self.ledger.append(
+                    "run",
+                    {
+                        "model": self.trainer.name,
+                        "config_hash": self.config_hash,
+                        "steps": steps,
+                        "items": items,
+                        "goodput": report,
+                        "final_metrics": final_metrics or None,
+                    },
+                    env=env_fingerprint(include_devices=True),
+                )
+        except Exception as e:  # observability must never fail the run
+            import sys
+
+            print(f"telemetry: run-record finalization failed: {e}",
+                  file=sys.stderr)

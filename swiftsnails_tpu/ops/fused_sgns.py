@@ -32,6 +32,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from swiftsnails_tpu.utils.compat import install_pallas_compat
+
+install_pallas_compat()  # modern pltpu.CompilerParams / BlockSpec on jax 0.4.x
+
 
 _WAIT_CHUNK = 64
 

@@ -1,6 +1,6 @@
-"""Unified telemetry: span tracing, metric registry, communication audit.
+"""Unified telemetry: tracing, metrics, audit — and the flight recorder.
 
-Three legs, one subsystem (the observability the reference never had —
+Six legs, one subsystem (the observability the reference never had —
 SURVEY §5 lists glog lines and a chrono ``Timer`` as its entire surface):
 
 * :mod:`~swiftsnails_tpu.telemetry.tracer` — host-side nestable spans with
@@ -11,7 +11,15 @@ SURVEY §5 lists glog lines and a chrono ``Timer`` as its entire surface):
   :class:`StdoutSummarySink` the terminal one);
 * :mod:`~swiftsnails_tpu.telemetry.audit` — per-collective op counts/bytes
   and cost/memory analysis from a step function's optimized HLO, sync and
-  async collective forms alike.
+  async collective forms alike;
+* :mod:`~swiftsnails_tpu.telemetry.ledger` — durable append-only JSONL run
+  ledger (atomic tmp+rename writes): bench results, training runs, outage
+  events, black-box dumps; ``BENCH_LAST_GOOD.json`` is a derived view;
+* :mod:`~swiftsnails_tpu.telemetry.goodput` — MFU, step-time decomposition
+  (compute vs collective vs host-blocked), words/sec-vs-roofline, combining
+  tracer spans with the HLO audit's cost analysis;
+* :mod:`~swiftsnails_tpu.telemetry.blackbox` — bounded ring of the last N
+  steps' spans/metrics, dumped to disk on exception, NaN/Inf loss, SIGTERM.
 
 Off by default: the TrainLoop only constructs these when the ``telemetry``
 or ``trace_path`` config keys are set, and its hot path pays one
@@ -32,6 +40,20 @@ from swiftsnails_tpu.telemetry.registry import (
     MetricRegistry,
     StdoutSummarySink,
 )
+from swiftsnails_tpu.telemetry.blackbox import BlackBox
+from swiftsnails_tpu.telemetry.goodput import (
+    goodput_report,
+    peaks_for,
+    step_time_decomposition,
+)
+from swiftsnails_tpu.telemetry.ledger import (
+    Ledger,
+    config_hash,
+    derive_last_good,
+    env_fingerprint,
+    load_bench_cache,
+    validate_bench_payload,
+)
 from swiftsnails_tpu.telemetry.summary import summarize_file
 from swiftsnails_tpu.telemetry.tracer import Tracer
 
@@ -47,10 +69,20 @@ __all__ = [
     "Histogram",
     "JsonlSink",
     "StdoutSummarySink",
+    "BlackBox",
+    "Ledger",
     "audit_compiled",
     "audit_step",
     "collective_bytes",
     "collective_stats",
     "compiled_collective_bytes",
+    "config_hash",
+    "derive_last_good",
+    "env_fingerprint",
+    "goodput_report",
+    "load_bench_cache",
+    "peaks_for",
+    "step_time_decomposition",
     "summarize_file",
+    "validate_bench_payload",
 ]

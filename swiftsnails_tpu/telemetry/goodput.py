@@ -1,0 +1,253 @@
+"""Goodput / MFU accounting: hardware-utilization numbers for a run.
+
+Combines the two raw signal sources PR 1 built —
+
+* the host span tracer (prefetch-wait / h2d / step spans per step), and
+* the compiled-HLO audit (per-step FLOPs, bytes accessed, collective bytes)
+
+— into the metrics TPU training stacks report as first-class: **MFU**
+(model FLOP utilization: achieved FLOP/s over the chip's peak), a
+**step-time decomposition** (compute vs collective vs host-blocked vs h2d),
+**goodput** (fraction of wall-clock spent inside productive steps), and a
+**words/sec-vs-roofline ratio** (measured throughput over the
+compute/memory-roofline bound for the compiled step).
+
+Everything here is pure host-side arithmetic over already-recorded data:
+no device work, no extra hot-path cost. Peaks come from a per-device-kind
+table (published chip specs) overridable via the ``peak_flops`` /
+``peak_hbm_gbps`` / ``peak_ici_gbps`` config keys — on CPU (tier-1 tests,
+smoke runs) there is no meaningful peak, so MFU degrades to ``None``
+rather than inventing a number.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+# Published per-chip peaks: (bf16 TFLOP/s, HBM GB/s, ICI GB/s per link-set).
+# Keyed by substrings of jax's ``device_kind`` / platform names; first match
+# wins. These anchor MFU the way the pjit-at-scale reports do (arXiv:
+# 2204.06514 reports hardware FLOP/s utilization against the chip peak).
+_PEAKS = (
+    ("v6", (918.0, 1640.0, 448.0)),       # Trillium / v6e
+    ("v5p", (459.0, 2765.0, 600.0)),
+    ("v5 lite", (197.0, 819.0, 200.0)),   # v5e device_kind is "TPU v5 lite"
+    ("v5e", (197.0, 819.0, 200.0)),
+    ("v5", (459.0, 2765.0, 600.0)),
+    ("v4", (275.0, 1228.0, 300.0)),
+    ("v3", (123.0, 900.0, 100.0)),
+    ("v2", (46.0, 700.0, 62.0)),
+)
+
+
+def peaks_for(device_kind: Optional[str]) -> Dict[str, Optional[float]]:
+    """Peak FLOP/s, HBM B/s, ICI B/s for a device kind (None when unknown,
+    e.g. CPU — never invent a utilization denominator)."""
+    if device_kind:
+        kind = device_kind.lower()
+        for key, (tf, hbm, ici) in _PEAKS:
+            if key in kind:
+                return {
+                    "flops_per_s": tf * 1e12,
+                    "hbm_bytes_per_s": hbm * 1e9,
+                    "ici_bytes_per_s": ici * 1e9,
+                    "source": f"builtin table ({key})",
+                }
+    return {
+        "flops_per_s": None,
+        "hbm_bytes_per_s": None,
+        "ici_bytes_per_s": None,
+        "source": "unknown device kind",
+    }
+
+
+def peaks_from_config(cfg, device_kind: Optional[str]) -> Dict:
+    """Table peaks with config-key overrides (``peak_flops`` in FLOP/s,
+    ``peak_hbm_gbps`` / ``peak_ici_gbps`` in GB/s)."""
+    peaks = peaks_for(device_kind)
+    if cfg is not None:
+        pf = cfg.get_float("peak_flops", 0.0)
+        if pf > 0:
+            peaks["flops_per_s"] = pf
+            peaks["source"] = "config"
+        hbm = cfg.get_float("peak_hbm_gbps", 0.0)
+        if hbm > 0:
+            peaks["hbm_bytes_per_s"] = hbm * 1e9
+        ici = cfg.get_float("peak_ici_gbps", 0.0)
+        if ici > 0:
+            peaks["ici_bytes_per_s"] = ici * 1e9
+    return peaks
+
+
+# ------------------------------------------------------ span decomposition ---
+
+# spans the TrainLoop emits, bucketed for the decomposition
+_SPAN_BUCKETS = {
+    "step": "compute_s",          # jitted dispatch + device sync
+    "h2d": "h2d_s",
+    "prefetch-wait": "host_blocked_s",
+    "metrics-flush": "other_s",
+    "checkpoint": "other_s",
+}
+
+
+def step_time_decomposition(events: Iterable[Dict]) -> Dict:
+    """Bucketed wall-clock split from tracer span events.
+
+    ``events`` is ``Tracer.events()`` output (dicts with ``name``/``ts_us``/
+    ``dur_us``). Top-level spans only (depth<=1 buckets; the per-step outer
+    ``step_span`` carries the trainer name and is skipped so nothing is
+    counted twice). Fractions are of the traced wall-clock between the first
+    span start and the last span end.
+    """
+    out = {
+        "wall_s": 0.0, "compute_s": 0.0, "h2d_s": 0.0,
+        "host_blocked_s": 0.0, "other_s": 0.0, "steps": 0,
+    }
+    t0, t1 = float("inf"), float("-inf")
+    for e in events:
+        ts = float(e.get("ts_us", 0.0))
+        dur = float(e.get("dur_us", 0.0))
+        t0 = min(t0, ts)
+        t1 = max(t1, ts + dur)
+        bucket = _SPAN_BUCKETS.get(e.get("name"))
+        if bucket is not None:
+            out[bucket] += dur / 1e6
+            if e.get("name") == "step":
+                out["steps"] += 1
+    if t1 > t0:
+        out["wall_s"] = (t1 - t0) / 1e6
+    wall = out["wall_s"]
+    if wall > 0:
+        accounted = (
+            out["compute_s"] + out["h2d_s"] + out["host_blocked_s"] + out["other_s"]
+        )
+        out["compute_frac"] = out["compute_s"] / wall
+        out["h2d_frac"] = out["h2d_s"] / wall
+        out["host_blocked_frac"] = out["host_blocked_s"] / wall
+        out["other_frac"] = out["other_s"] / wall
+        out["unaccounted_frac"] = max(1.0 - accounted / wall, 0.0)
+    return out
+
+
+# ------------------------------------------------------------- roofline ---
+
+
+def roofline_step_seconds(
+    flops: Optional[float],
+    hbm_bytes: Optional[float],
+    collective_bytes: Optional[float],
+    peaks: Dict,
+) -> Optional[float]:
+    """Lower bound on one step's duration from the compiled cost analysis:
+    max over the compute, HBM, and interconnect rooflines (each skipped when
+    its peak or numerator is unknown)."""
+    bounds = []
+    if flops and peaks.get("flops_per_s"):
+        bounds.append(flops / peaks["flops_per_s"])
+    if hbm_bytes and peaks.get("hbm_bytes_per_s"):
+        bounds.append(hbm_bytes / peaks["hbm_bytes_per_s"])
+    if collective_bytes and peaks.get("ici_bytes_per_s"):
+        bounds.append(collective_bytes / peaks["ici_bytes_per_s"])
+    return max(bounds) if bounds else None
+
+
+def goodput_report(
+    *,
+    events: Optional[Sequence[Dict]] = None,
+    audit: Optional[Dict] = None,
+    steps: Optional[int] = None,
+    items: Optional[int] = None,
+    step_seconds: Optional[float] = None,
+    peaks: Optional[Dict] = None,
+    n_chips: int = 1,
+) -> Dict:
+    """The per-run goodput block.
+
+    Inputs are all optional — the report states what it could compute and
+    carries ``None`` for the rest (a CPU smoke run has spans but no peak;
+    an audit-less run has timings but no FLOPs).
+
+    * ``events``: tracer span dicts (gives the decomposition + step timing);
+    * ``audit``: a :func:`telemetry.audit.audit_step` report (FLOPs, bytes
+      accessed, collective bytes) for ONE step dispatch;
+    * ``steps`` / ``items``: loop totals (items = words/examples);
+    * ``step_seconds``: measured per-step seconds — derived from the spans
+      when absent;
+    * ``peaks``: :func:`peaks_for` / :func:`peaks_from_config` output;
+    * ``n_chips``: devices sharing the audited step's FLOPs (per-chip MFU).
+    """
+    peaks = peaks or peaks_for(None)
+    report: Dict = {"peaks": {k: v for k, v in peaks.items()}}
+
+    dec = None
+    if events:
+        dec = step_time_decomposition(events)
+        report["decomposition"] = dec
+        if steps is None:
+            steps = dec["steps"] or None
+    if steps:
+        report["steps"] = int(steps)
+    if items is not None:
+        report["items"] = int(items)
+
+    if step_seconds is None and dec and dec["steps"]:
+        step_seconds = dec["compute_s"] / dec["steps"]
+    report["step_seconds"] = step_seconds
+
+    # goodput: productive (in-step) fraction of the traced wall-clock
+    if dec and dec["wall_s"] > 0:
+        report["goodput"] = dec["compute_s"] / dec["wall_s"]
+
+    flops = hbm_bytes = coll_bytes = None
+    if audit:
+        cost = audit.get("cost", {}) or {}
+        flops = cost.get("flops")
+        hbm_bytes = cost.get("bytes_accessed")
+        coll_bytes = audit.get("total_bytes", audit.get("collective_bytes"))
+        report["flops_per_step"] = flops
+        report["hbm_bytes_per_step"] = hbm_bytes
+        report["collective_bytes_per_step"] = coll_bytes
+
+    # MFU: achieved FLOP/s over peak, per chip
+    mfu = None
+    if flops and step_seconds and peaks.get("flops_per_s"):
+        mfu = (flops / n_chips) / step_seconds / peaks["flops_per_s"]
+    report["mfu"] = mfu
+
+    # model-based split of the measured step time into compute vs collective
+    # (roofline estimates normalized onto the measured step — labeled est)
+    if step_seconds and step_seconds > 0:
+        comp_est = (
+            flops / n_chips / peaks["flops_per_s"]
+            if flops and peaks.get("flops_per_s") else None
+        )
+        coll_est = (
+            coll_bytes / n_chips / peaks["ici_bytes_per_s"]
+            if coll_bytes and peaks.get("ici_bytes_per_s") else None
+        )
+        if comp_est is not None or coll_est is not None:
+            report["step_split_est"] = {
+                "compute_frac": (comp_est or 0.0) / step_seconds,
+                "collective_frac": (coll_est or 0.0) / step_seconds,
+            }
+
+    # words/sec vs roofline: measured items/s over the bound the compiled
+    # step admits on this chip
+    ideal_s = roofline_step_seconds(
+        flops / n_chips if flops else None,
+        hbm_bytes / n_chips if hbm_bytes else None,
+        coll_bytes / n_chips if coll_bytes else None,
+        peaks,
+    )
+    report["roofline_step_seconds"] = ideal_s
+    if ideal_s and steps and items and step_seconds:
+        items_per_step = items / steps
+        measured_rate = items_per_step / step_seconds
+        roofline_rate = items_per_step / ideal_s
+        report["items_per_sec"] = measured_rate
+        report["roofline_items_per_sec"] = roofline_rate
+        report["vs_roofline"] = measured_rate / roofline_rate
+    elif steps and items and step_seconds:
+        report["items_per_sec"] = (items / steps) / step_seconds
+    return report

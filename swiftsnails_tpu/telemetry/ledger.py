@@ -1,0 +1,497 @@
+"""Durable run ledger: append-only, atomically-written JSONL run records.
+
+The reference system's only run record was stdout from Hadoop reducers; this
+repo's was barely better — round 5's headline bench artifact lived in one
+fragile ``BENCH_LAST_GOOD.json`` that a workspace restart erased (it had to be
+hand-reconstructed, ``BENCH_r05.json`` ``errors[0]``), and a 27-failure
+accelerator outage was logged by hand in ``docs/OUTAGE_r5_probe.txt``. The
+ledger replaces both: every bench run, training run, outage/probe event, and
+black-box dump appends one self-describing record, and the single-file cache
+becomes a **derived view** regenerated from the ledger
+(:func:`derive_last_good`).
+
+Durability contract: every append rewrites the file via write-tmp + fsync +
+rename (+ directory fsync), so the ledger on disk is *always* a complete,
+parseable JSONL file — a crash mid-append leaves the previous version, never
+a torn line. Appends are rare (one per run/outage), so the O(file) rewrite is
+irrelevant; single-writer per path is assumed (the bench and trainer are).
+
+Record envelope::
+
+    {"schema": 1, "kind": "bench"|"run"|"outage"|"blackbox",
+     "ts": "<UTC ISO8601>", "env": {...fingerprint...}, ...kind fields...}
+
+``python -m swiftsnails_tpu ledger-report`` (or ``tools/ledger_report.py``)
+renders the ledger; its ``--check-regression`` mode is the bench gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+# default ledger location: next to BENCH_LAST_GOOD.json at the repo root,
+# overridable per-call (config `ledger_path`) or via env for the bench
+DEFAULT_LEDGER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "RUN_LEDGER.jsonl",
+)
+
+
+def _utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+# ------------------------------------------------------- env fingerprint ---
+
+
+def _git_sha(cwd: Optional[str] = None) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd or os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def env_fingerprint(include_devices: bool = False) -> Dict:
+    """Environment identity of a run: git sha, jax/jaxlib/libtpu versions,
+    python, host — and device topology when ``include_devices`` is set.
+
+    ``include_devices`` intentionally defaults to False: querying devices
+    *initializes the backend*, and the bench must never touch the
+    accelerator before its pre-flight probe (the round-1 wedged-grant
+    lesson). Pass True only where jax is already live, or fill the
+    ``devices`` block from probe output instead.
+    """
+    fp: Dict = {
+        "git_sha": _git_sha(),
+        "python": sys.version.split()[0],
+        "host": os.uname().nodename if hasattr(os, "uname") else None,
+    }
+    try:
+        import jax
+
+        fp["jax"] = jax.__version__
+        try:
+            import jaxlib
+
+            fp["jaxlib"] = getattr(jaxlib, "__version__", None)
+        except ImportError:
+            fp["jaxlib"] = None
+        try:
+            from importlib import metadata
+
+            fp["libtpu"] = metadata.version("libtpu")
+        except Exception:
+            fp["libtpu"] = None
+        if include_devices:
+            devs = jax.devices()
+            fp["devices"] = {
+                "platform": devs[0].platform,
+                "count": len(devs),
+                "kind": getattr(devs[0], "device_kind", None),
+                "process_count": jax.process_count(),
+            }
+    except Exception as e:  # jax missing/broken must not kill record-keeping
+        fp["jax_error"] = f"{type(e).__name__}: {e}"
+    return fp
+
+
+def config_hash(conf: Dict) -> str:
+    """Stable short hash of a flat config mapping (order-independent)."""
+    blob = json.dumps(
+        {str(k): str(v) for k, v in conf.items()}, sort_keys=True
+    ).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+# ---------------------------------------------------------- atomic write ---
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` via tmp + fsync + rename (+ dir fsync):
+    readers only ever see the old or the new complete file."""
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".", dir=d)
+    try:
+        try:
+            os.write(fd, data)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.rename(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:  # persist the rename itself
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # e.g. directories that reject O_RDONLY open; data is renamed
+
+
+def atomic_write_json(path: str, obj) -> None:
+    atomic_write_bytes(path, (json.dumps(obj) + "\n").encode("utf-8"))
+
+
+# ----------------------------------------------------------------- ledger ---
+
+
+class Ledger:
+    """Append-only JSONL run ledger with atomic rewrites.
+
+    ``append`` returns the full record written (envelope included) so call
+    sites can echo/forward it. All read paths tolerate a corrupt line
+    (reported, never raised) — a half-written legacy file or a foreign line
+    must not take down the bench.
+    """
+
+    def __init__(self, path: str = DEFAULT_LEDGER):
+        self.path = os.path.abspath(path)
+
+    # -- write -------------------------------------------------------------
+
+    def append(self, kind: str, record: Dict, env: Optional[Dict] = None) -> Dict:
+        full = {"schema": SCHEMA_VERSION, "kind": kind, "ts": _utc_now()}
+        if env is not None:
+            full["env"] = env
+        full.update(record)
+        line = json.dumps(full) + "\n"
+        try:
+            with open(self.path, "rb") as f:
+                existing = f.read()
+            if existing and not existing.endswith(b"\n"):
+                existing += b"\n"  # heal a torn legacy tail
+        except OSError:
+            existing = b""
+        atomic_write_bytes(self.path, existing + line.encode("utf-8"))
+        return full
+
+    # -- read --------------------------------------------------------------
+
+    def replay(self) -> Tuple[List[Dict], List[str]]:
+        """All parseable records plus a list of corrupt-line descriptions."""
+        records: List[Dict] = []
+        bad: List[str] = []
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError:
+            return records, bad
+        for lineno, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                bad.append(f"{self.path}:{lineno}: unparseable line skipped")
+                continue
+            if isinstance(rec, dict):
+                records.append(rec)
+            else:
+                bad.append(f"{self.path}:{lineno}: non-object record skipped")
+        return records, bad
+
+    def records(self, kind: Optional[str] = None) -> List[Dict]:
+        recs, _ = self.replay()
+        if kind is None:
+            return recs
+        return [r for r in recs if r.get("kind") == kind]
+
+    def latest(self, kind: str) -> Optional[Dict]:
+        recs = self.records(kind)
+        return recs[-1] if recs else None
+
+
+# --------------------------------------------- bench cache (derived view) ---
+
+# minimal self-consistency schema for a bench result payload: what the
+# outage-fallback path needs to emit a trustworthy headline
+_BENCH_REQUIRED = {
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "config": dict,
+}
+
+
+def validate_bench_payload(payload) -> List[str]:
+    """Problems that make a bench payload unusable as a cached headline."""
+    if not isinstance(payload, dict):
+        return [f"payload is {type(payload).__name__}, not an object"]
+    problems = []
+    for key, typ in _BENCH_REQUIRED.items():
+        if key not in payload:
+            problems.append(f"missing required key {key!r}")
+        elif not isinstance(payload[key], typ):
+            problems.append(
+                f"key {key!r} has type {type(payload[key]).__name__}"
+            )
+    value = payload.get("value")
+    if isinstance(value, (int, float)) and not value > 0:
+        problems.append(f"non-positive headline value {value!r}")
+    return problems
+
+
+def load_bench_cache(path: str) -> Tuple[Optional[Dict], Optional[str]]:
+    """Read + schema-validate a BENCH_LAST_GOOD-style cache file.
+
+    Returns ``(payload, None)`` on success, ``(None, reason)`` on a missing,
+    partial, or unparseable cache — the caller records the reason as a
+    ledger event instead of crashing (or silently emitting garbage).
+    """
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            payload = json.load(f)
+    except OSError as e:
+        return None, f"cache unreadable: {e}"
+    except ValueError as e:
+        return None, f"cache unparseable (partial write?): {e}"
+    problems = validate_bench_payload(payload)
+    if problems:
+        return None, "cache failed schema validation: " + "; ".join(problems)
+    return payload, None
+
+
+def derive_last_good(
+    ledger: Ledger, out_path: str
+) -> Tuple[Optional[Dict], Optional[str]]:
+    """Regenerate the BENCH_LAST_GOOD.json **derived view** from the ledger.
+
+    The newest ``bench`` record flagged ``cacheable`` whose payload passes
+    schema validation wins. Returns ``(payload_written, None)`` or
+    ``(None, reason)`` when the ledger holds no cacheable record.
+    """
+    candidates = [
+        r for r in ledger.records("bench")
+        if r.get("cacheable") and isinstance(r.get("payload"), dict)
+    ]
+    for rec in reversed(candidates):
+        payload = rec["payload"]
+        if validate_bench_payload(payload):
+            continue
+        payload = dict(payload)
+        payload.setdefault("measured_at", rec.get("ts"))
+        atomic_write_json(out_path, payload)
+        return payload, None
+    return None, "no cacheable bench record in ledger"
+
+
+def outage_summary(ledger: Ledger) -> Optional[Dict]:
+    """Structured summary of the most recent outage: the line that used to be
+    hand-written into ``docs/OUTAGE_*.txt``."""
+    outages = ledger.records("outage")
+    if not outages:
+        return None
+    last = outages[-1]
+    return {
+        "at": last.get("ts"),
+        "probe_duration_s": last.get("probe_duration_s"),
+        "rc": last.get("rc"),
+        "error": last.get("error"),
+        "outages_recorded": len(outages),
+    }
+
+
+# -------------------------------------------------------------- reporting ---
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, float):
+        return f"{v:,.3f}" if abs(v) < 10 else f"{v:,.1f}"
+    return str(v)
+
+
+def render_report(ledger: Ledger) -> str:
+    """Terminal rendering of the ledger: run/bench/outage/black-box history."""
+    records, bad = ledger.replay()
+    if not records and not bad:
+        return f"{ledger.path}: empty or missing ledger"
+    lines = [f"ledger: {ledger.path}  ({len(records)} records)"]
+    counts: Dict[str, int] = {}
+    for r in records:
+        counts[r.get("kind", "?")] = counts.get(r.get("kind", "?"), 0) + 1
+    lines.append(
+        "  " + "  ".join(f"{k}={n}" for k, n in sorted(counts.items()))
+    )
+    for warn in bad:
+        lines.append(f"  WARNING: {warn}")
+
+    bench = ledger.records("bench")
+    if bench:
+        lines.append("")
+        lines.append("bench records (newest last):")
+        for r in bench[-5:]:
+            p = r.get("payload", {}) if isinstance(r.get("payload"), dict) else {}
+            env = r.get("env", {}) or {}
+            flags = []
+            if r.get("cacheable"):
+                flags.append("cacheable")
+            if p.get("cached"):
+                flags.append("cached")
+            if p.get("reconstructed"):
+                flags.append("reconstructed")
+            lines.append(
+                f"  {r.get('ts', '?')}  value={_fmt_num(p.get('value', 0))} "
+                f"{p.get('unit', '')}  path={p.get('path')}  "
+                f"platform={p.get('platform')}  git={str(env.get('git_sha'))[:9]}"
+                f"  config_hash={r.get('config_hash', '?')}"
+                + (f"  [{','.join(flags)}]" if flags else "")
+            )
+
+    runs = ledger.records("run")
+    if runs:
+        lines.append("")
+        lines.append("training runs (newest last):")
+        for r in runs[-5:]:
+            g = r.get("goodput", {}) or {}
+            mfu = g.get("mfu")
+            dec = g.get("decomposition", {}) or {}
+            lines.append(
+                f"  {r.get('ts', '?')}  model={r.get('model')}  "
+                f"steps={r.get('steps')}  items={r.get('items')}  "
+                f"config_hash={r.get('config_hash', '?')}  "
+                f"mfu={'%.3g' % mfu if isinstance(mfu, (int, float)) else 'n/a'}"
+            )
+            if dec:
+                lines.append(
+                    "    step-time: "
+                    + "  ".join(
+                        f"{k}={dec[k] * 100:.1f}%"
+                        for k in ("compute_frac", "h2d_frac",
+                                  "host_blocked_frac", "other_frac")
+                        if isinstance(dec.get(k), (int, float))
+                    )
+                )
+
+    outages = ledger.records("outage")
+    if outages:
+        lines.append("")
+        lines.append(f"outages ({len(outages)} recorded, newest last):")
+        for r in outages[-5:]:
+            lines.append(
+                f"  {r.get('ts', '?')}  probe={_fmt_num(r.get('probe_duration_s', 0))}s"
+                f"  rc={r.get('rc')}  {r.get('error', '')[:90]}"
+            )
+
+    boxes = ledger.records("blackbox")
+    if boxes:
+        lines.append("")
+        lines.append("black-box dumps (newest last):")
+        for r in boxes[-5:]:
+            lines.append(
+                f"  {r.get('ts', '?')}  reason={r.get('reason')}  "
+                f"steps={r.get('first_step')}..{r.get('last_step')}  "
+                f"file={r.get('dump_path')}"
+            )
+    return "\n".join(lines)
+
+
+def check_regression(
+    ledger: Ledger,
+    max_drop_pct: float,
+    baseline: Optional[float] = None,
+) -> Tuple[int, str]:
+    """Bench gate: newest *measured* bench value vs the pinned baseline.
+
+    ``baseline``: explicit pinned words/sec value; default is the best value
+    among all earlier measured (non-cached, non-reconstructed, on-chip —
+    CPU smoke runs never count) bench records. Returns ``(exit_code,
+    message)`` — nonzero when the newest run is more than ``max_drop_pct``
+    percent below the baseline (or nothing to gate on).
+    """
+    measured = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and not r["payload"].get("cached")
+        and not r["payload"].get("reconstructed")
+        and r["payload"].get("platform") != "cpu"
+        and isinstance(r["payload"].get("value"), (int, float))
+        and r["payload"]["value"] > 0
+    ]
+    if not measured:
+        return 2, "check-regression: no measured bench record in ledger"
+    newest = measured[-1]["payload"]["value"]
+    if baseline is None:
+        earlier = [r["payload"]["value"] for r in measured[:-1]]
+        if not earlier:
+            return 0, (
+                f"check-regression: single measured record "
+                f"(value={newest:,.1f}); nothing to compare against"
+            )
+        baseline = max(earlier)
+    floor = baseline * (1.0 - max_drop_pct / 100.0)
+    if newest < floor:
+        return 1, (
+            f"REGRESSION: newest value {newest:,.1f} is "
+            f"{(1 - newest / baseline) * 100:.1f}% below baseline "
+            f"{baseline:,.1f} (allowed {max_drop_pct:.1f}%)"
+        )
+    return 0, (
+        f"ok: newest value {newest:,.1f} vs baseline {baseline:,.1f} "
+        f"({(newest / baseline - 1) * 100:+.1f}%, floor {floor:,.1f})"
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="ledger_report",
+        description="Render the run ledger; optionally gate on bench regression.",
+    )
+    p.add_argument(
+        "path", nargs="?", default=DEFAULT_LEDGER,
+        help=f"ledger JSONL (default: {DEFAULT_LEDGER})",
+    )
+    p.add_argument(
+        "--check-regression", type=float, metavar="PCT", default=None,
+        help="exit nonzero if the newest measured bench value is more than "
+             "PCT%% below the pinned baseline (bench gate mode)",
+    )
+    p.add_argument(
+        "--baseline", type=float, default=None,
+        help="explicit pinned baseline value for --check-regression "
+             "(default: best earlier measured record in the ledger)",
+    )
+    p.add_argument(
+        "--baseline-file", default=None,
+        help="JSON file whose 'value' field is the pinned baseline "
+             "(e.g. a preserved BENCH_LAST_GOOD.json)",
+    )
+    args = p.parse_args(argv)
+    ledger = Ledger(args.path)
+    if args.check_regression is not None:
+        baseline = args.baseline
+        if baseline is None and args.baseline_file:
+            payload, err = load_bench_cache(args.baseline_file)
+            if err:
+                print(f"ledger_report: --baseline-file: {err}")
+                return 2
+            baseline = float(payload["value"])
+        rc, msg = check_regression(ledger, args.check_regression, baseline)
+        print(msg)
+        return rc
+    print(render_report(ledger))
+    return 0

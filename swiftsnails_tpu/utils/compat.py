@@ -6,10 +6,23 @@ the way — and the two moves did not happen in the same release. Every
 collective plane in :mod:`swiftsnails_tpu.parallel` calls the wrapper below
 with the modern keyword; it lands on whichever implementation and keyword the
 installed jax provides.
+
+The Pallas TPU surface moved the same way, release-skewed:
+
+* ``pltpu.TPUCompilerParams`` was renamed ``pltpu.CompilerParams`` and grew
+  new fields (``has_side_effects``) that 0.4.x never had;
+* ``pl.BlockSpec`` swapped its positional args from ``(index_map,
+  block_shape)`` to ``(block_shape, index_map)``.
+
+The kernels in :mod:`swiftsnails_tpu.ops` are written against the modern
+names; :func:`install_pallas_compat` retrofits the installed
+``jax.experimental.pallas`` modules so they import-and-compile on either
+side of the skew (the ROADMAP "jax-version gap" item).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import inspect
 
 try:  # modern jax: top-level export
@@ -32,3 +45,55 @@ def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = False):
         f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         **{_CHECK_KW: check_vma},
     )
+
+
+# ----------------------------------------------------------- pallas shim ---
+
+_pallas_compat_installed = False
+
+
+def _compiler_params_factory(cls):
+    """A ``CompilerParams(**kw)`` callable that drops the kwargs the installed
+    dataclass predates (0.4.x ``TPUCompilerParams`` has no
+    ``has_side_effects``; the kernels that pass it all return their aliased
+    outputs, so nothing is DCE'd without the flag)."""
+    supported = {f.name for f in dataclasses.fields(cls)}
+
+    def make(**kwargs):
+        return cls(**{k: v for k, v in kwargs.items() if k in supported})
+
+    return make
+
+
+def _blockspec_needs_swap(blockspec_cls) -> bool:
+    """True when the installed ``pl.BlockSpec`` still takes the legacy
+    ``(index_map, block_shape)`` positional order."""
+    try:
+        params = list(inspect.signature(blockspec_cls.__init__).parameters)
+    except (TypeError, ValueError):
+        return False
+    # params[0] is self; modern order leads with block_shape
+    return len(params) > 1 and params[1] == "index_map"
+
+
+def install_pallas_compat() -> None:
+    """Retrofit ``jax.experimental.pallas`` (+ ``.tpu``) with the modern
+    names the kernels use. Idempotent; call before any ``pltpu.*`` use."""
+    global _pallas_compat_installed
+    if _pallas_compat_installed:
+        return
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if not hasattr(pltpu, "CompilerParams"):
+        legacy = getattr(pltpu, "TPUCompilerParams", None)
+        if legacy is not None:
+            pltpu.CompilerParams = _compiler_params_factory(legacy)
+    if _blockspec_needs_swap(pl.BlockSpec):
+        legacy_bs = pl.BlockSpec
+
+        def block_spec(block_shape=None, index_map=None, **kwargs):
+            return legacy_bs(index_map, block_shape, **kwargs)
+
+        pl.BlockSpec = block_spec
+    _pallas_compat_installed = True

@@ -1,0 +1,137 @@
+"""bench.py <-> ledger integration: structured outage events from the probe,
+corrupt-cache rejection with regeneration from the ledger, and the derived
+last-good view written through the ledger on save."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+from swiftsnails_tpu.telemetry.ledger import Ledger
+
+
+@pytest.fixture()
+def isolated_bench(tmp_path, monkeypatch):
+    """Point bench's module-level artifact paths at a tmp dir and reset the
+    one-shot emit latch + error list."""
+    monkeypatch.setattr(bench, "LEDGER_PATH", str(tmp_path / "ledger.jsonl"))
+    monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(tmp_path / "last_good.json"))
+    monkeypatch.setattr(bench, "_emitted", False)
+    monkeypatch.setitem(bench._state, "errors", [])
+    return tmp_path
+
+
+def current_payload(value=123456.0):
+    """A payload whose config matches this build (the fallback config gate)."""
+    p = json.loads(bench._result_json())
+    p.update({"value": value, "path": "dense", "platform": "tpu",
+              "paths": {"dense": value}, "errors": []})
+    return p
+
+
+def test_probe_timeout_writes_structured_outage_event(isolated_bench, monkeypatch):
+    class HungChild:
+        returncode = None
+
+        def communicate(self, timeout=None):
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **kw: HungChild())
+    assert bench.probe_accelerator() is None
+    ev = Ledger(bench.LEDGER_PATH).latest("outage")
+    assert ev is not None
+    assert ev["rc"] is None  # abandoned, never reaped
+    assert isinstance(ev["probe_duration_s"], (int, float))
+    assert "grant unavailable" in ev["error"]
+    assert any("grant unavailable" in e for e in bench._state["errors"])
+
+
+def test_probe_rc_failure_writes_outage_event(isolated_bench, monkeypatch):
+    class DeadChild:
+        returncode = 17
+
+        def communicate(self, timeout=None):
+            return "", "boom: no TPU platform"
+
+    monkeypatch.setattr(bench.subprocess, "Popen",
+                        lambda *a, **kw: DeadChild())
+    assert bench.probe_accelerator() is None
+    ev = Ledger(bench.LEDGER_PATH).latest("outage")
+    assert ev["rc"] == 17 and "rc=17" in ev["error"]
+
+
+def test_cached_fallback_rejects_corrupt_cache_and_regenerates(
+        isolated_bench, monkeypatch, capsys):
+    # a torn cache file on disk + a healthy cacheable record in the ledger
+    with open(bench.LAST_GOOD_PATH, "w") as f:
+        f.write('{"metric": "word2vec_words_per_sec_per_chip", "valu')
+    Ledger(bench.LEDGER_PATH).append(
+        "bench", {"payload": current_payload(), "cacheable": True})
+    assert bench._emit_cached_fallback() is True
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    emitted = json.loads(out)  # driver contract: one strict-JSON line
+    assert emitted["cached"] is True
+    assert emitted["value"] == 123456.0
+    errs = " | ".join(emitted["errors"])
+    assert "cache rejected" in errs and "regenerated from the run ledger" in errs
+    # the rejection is a ledger event, and the view was rewritten valid
+    led = Ledger(bench.LEDGER_PATH)
+    assert led.latest("cache_error") is not None
+    assert json.load(open(bench.LAST_GOOD_PATH))["value"] == 123456.0
+
+
+def test_cached_fallback_attaches_last_outage_summary(isolated_bench, capsys):
+    led = Ledger(bench.LEDGER_PATH)
+    for _ in range(3):
+        led.append("outage", {"probe_duration_s": 300.0, "rc": None,
+                              "error": "grant unavailable"})
+    payload = current_payload()
+    from swiftsnails_tpu.telemetry.ledger import atomic_write_json
+
+    atomic_write_json(bench.LAST_GOOD_PATH, payload)
+    assert bench._emit_cached_fallback() is True
+    emitted = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the structured summary replaces the hand-typed OUTAGE_*.txt line
+    assert emitted["last_outage"]["outages_recorded"] == 3
+    assert emitted["last_outage"]["probe_duration_s"] == 300.0
+    assert any("3 outages recorded" in e for e in emitted["errors"])
+
+
+def test_cached_fallback_missing_cache_and_empty_ledger_is_quiet(isolated_bench):
+    assert bench._emit_cached_fallback() is False
+    # a merely-missing cache is not a corruption event
+    assert Ledger(bench.LEDGER_PATH).latest("cache_error") is None
+
+
+def test_save_last_good_routes_through_ledger(isolated_bench, monkeypatch):
+    # make this run look like a valid full headline run
+    monkeypatch.setitem(bench._state, "best", 999999.0)
+    monkeypatch.setitem(bench._state, "best_path", "dense")
+    monkeypatch.setitem(bench._state, "platform", "tpu")
+    monkeypatch.setitem(bench._state, "attempted", {
+        "dense", "packed+pool", "fused-hogwild", "fused-grouped",
+        "fused-resident", "fused-dedup"})
+    monkeypatch.setattr(bench, "_SMALL", False)
+    bench._save_last_good()
+    led = Ledger(bench.LEDGER_PATH)
+    rec = led.latest("bench")
+    assert rec["cacheable"] is True
+    assert rec["payload"]["value"] == 999999.0
+    assert rec["payload"]["reconstructed"] is False
+    assert "env" in rec and len(rec["config_hash"]) == 16
+    # the derived view is regenerated from the ledger, atomically
+    view = json.load(open(bench.LAST_GOOD_PATH))
+    assert view["value"] == 999999.0
+    # an invalid (cpu / truncated) run is recorded but NOT cacheable, and
+    # must not overwrite the view
+    monkeypatch.setitem(bench._state, "platform", "cpu")
+    monkeypatch.setitem(bench._state, "best", 1.0)
+    bench._save_last_good()
+    assert led.latest("bench")["cacheable"] is False
+    assert json.load(open(bench.LAST_GOOD_PATH))["value"] == 999999.0

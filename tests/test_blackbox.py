@@ -1,0 +1,189 @@
+"""Failure black-box: bounded ring, dump triggers (injected NaN loss,
+raised exception, SIGTERM), artifact contents, and the ledger event."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from swiftsnails_tpu.framework.trainer import Trainer, TrainLoop
+from swiftsnails_tpu.telemetry.blackbox import BlackBox
+from swiftsnails_tpu.telemetry.ledger import Ledger
+from swiftsnails_tpu.utils.config import Config
+from swiftsnails_tpu.utils.metrics import MetricsLogger
+
+
+# ----------------------------------------------------------- ring basics
+
+
+def test_ring_is_bounded_and_ordered():
+    bb = BlackBox(capacity=4)
+    for i in range(10):
+        bb.record_step(i, step_ms=1.0)
+    steps = [s["step"] for s in bb.snapshot()]
+    assert steps == [6, 7, 8, 9]
+
+
+def test_record_metrics_attaches_to_existing_entry():
+    bb = BlackBox(capacity=4)
+    bb.record_step(3, step_ms=2.0)
+    bb.record_metrics(3, {"loss": 0.5})
+    snap = bb.snapshot()
+    assert len(snap) == 1
+    assert snap[0]["metrics"] == {"loss": 0.5}
+    # a flush for a step no longer in the ring still lands as its own entry
+    bb.record_metrics(99, {"loss": 0.1})
+    assert bb.snapshot()[-1]["step"] == 99
+
+
+def test_nonfinite_detector():
+    assert BlackBox.nonfinite({"loss": float("nan"), "acc": 1.0}) == ["loss"]
+    assert BlackBox.nonfinite({"loss": float("inf")}) == ["loss"]
+    assert BlackBox.nonfinite({"loss": 0.0}) == []
+
+
+def test_dump_writes_artifact_once_per_reason(tmp_path):
+    led = Ledger(str(tmp_path / "ledger.jsonl"))
+    bb = BlackBox(capacity=4, directory=str(tmp_path / "bb"), ledger=led,
+                  context={"model": "m"})
+    bb.record_step(1, step_ms=1.5)
+    bb.record_metrics(1, {"loss": float("nan")})
+    path = bb.dump("nan-loss")
+    assert path is not None and os.path.exists(path)
+    assert bb.dump("nan-loss") is None  # once-per-reason
+    doc = json.load(open(path))
+    assert doc["reason"] == "nan-loss"
+    assert doc["context"] == {"model": "m"}
+    assert doc["steps"][0]["metrics"]["loss"] != doc["steps"][0]["metrics"]["loss"]
+    assert "env" in doc and "jax" in doc["env"]
+    # the ledger points at the artifact
+    ev = led.latest("blackbox")
+    assert ev["reason"] == "nan-loss"
+    assert ev["dump_path"] == os.path.abspath(path)
+    assert ev["first_step"] == 1 and ev["last_step"] == 1
+
+
+def test_sigterm_handler_dumps_then_chains(tmp_path):
+    calls = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: calls.append(s))
+    try:
+        bb = BlackBox(capacity=2, directory=str(tmp_path / "bb"))
+        bb.record_step(1)
+        assert bb.install_signal_handler() is True
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert calls == [signal.SIGTERM]  # chained to the previous handler
+        dumps = os.listdir(tmp_path / "bb")
+        assert len(dumps) == 1 and "sigterm" in dumps[0]
+        bb.uninstall_signal_handler()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ----------------------------------------------- TrainLoop trigger wiring
+
+
+class ToyTrainer(Trainer):
+    """5 tiny batches; optionally NaN loss from a given step, or a raising
+    batch iterator — the failure-injection harness for the loop tests."""
+
+    name = "toy"
+
+    def __init__(self, config, nan_from=None, raise_at=None):
+        super().__init__(config, mesh=None)
+        self.nan_from = nan_from
+        self.raise_at = raise_at
+
+    def init_state(self):
+        return {"w": jnp.zeros((4,), jnp.float32)}
+
+    def batches(self):
+        for i in range(5):
+            if self.raise_at is not None and i == self.raise_at:
+                raise RuntimeError("injected data failure")
+            yield {"x": np.full((8, 4), i + 1, np.float32)}
+
+    def train_step(self, state, batch, rng):
+        w = state["w"] + batch["x"].mean(0)
+        loss = w.sum()
+        if self.nan_from is not None:
+            loss = loss / 0.0 * 0.0  # inf * 0 -> NaN, every step
+        return {"w": w}, {"loss": loss}
+
+
+def make_loop(tmp_path, log_every=1, **trainer_kw):
+    cfg = Config({
+        "telemetry": "1",
+        "blackbox_steps": "3",
+        "blackbox_dir": str(tmp_path / "bb"),
+        "ledger_path": str(tmp_path / "ledger.jsonl"),
+        "prefetch_batches": "1",
+    })
+    trainer = ToyTrainer(cfg, **trainer_kw)
+    return TrainLoop(trainer, metrics=MetricsLogger(echo=False),
+                     log_every=log_every)
+
+
+def test_trainloop_dumps_on_injected_nan(tmp_path):
+    loop = make_loop(tmp_path, nan_from=0)
+    loop.run(max_steps=5)
+    dumps = os.listdir(tmp_path / "bb")
+    # exactly ONE dump despite the loss staying NaN for all 5 flushes
+    assert len(dumps) == 1 and "nan-loss" in dumps[0], dumps
+    doc = json.load(open(tmp_path / "bb" / dumps[0]))
+    # dumped at the FIRST flush that saw the NaN (log_every=1 -> step 1),
+    # with the metrics attached and the tracer spans captured
+    steps = [s["step"] for s in doc["steps"]]
+    assert steps == [1]
+    assert any("metrics" in s for s in doc["steps"])
+    span_names = {s["name"] for s in doc.get("spans", [])}
+    assert {"step", "h2d"} <= span_names
+    assert doc["context"]["model"] == "toy"
+    # and the ledger records both the dump and the completed run
+    led = Ledger(str(tmp_path / "ledger.jsonl"))
+    assert led.latest("blackbox")["reason"] == "nan-loss"
+    assert led.latest("run")["steps"] == 5
+
+
+def test_trainloop_nan_detected_at_final_flush_only(tmp_path):
+    # log_every larger than the run: host metrics only materialize at the
+    # final window — the dump must still happen, and the bounded ring holds
+    # the FINAL steps (capacity 3 of 5): the acceptance artifact
+    loop = make_loop(tmp_path, log_every=100, nan_from=0)
+    loop.run(max_steps=5)
+    dumps = os.listdir(tmp_path / "bb")
+    assert len(dumps) == 1 and "nan-loss" in dumps[0]
+    doc = json.load(open(tmp_path / "bb" / dumps[0]))
+    assert [s["step"] for s in doc["steps"]] == [3, 4, 5]
+
+
+def test_trainloop_dumps_on_exception(tmp_path):
+    loop = make_loop(tmp_path, raise_at=3)
+    with pytest.raises(RuntimeError, match="injected data failure"):
+        loop.run(max_steps=10)
+    dumps = os.listdir(tmp_path / "bb")
+    assert len(dumps) == 1 and "exception" in dumps[0]
+    doc = json.load(open(tmp_path / "bb" / dumps[0]))
+    assert doc["exception"]["type"] == "RuntimeError"
+    assert "injected data failure" in doc["exception"]["message"]
+    assert doc["steps"]  # the ring captured the steps before the failure
+    led = Ledger(str(tmp_path / "ledger.jsonl"))
+    ev = led.latest("blackbox")
+    assert ev["exception"]["type"] == "RuntimeError"
+
+
+def test_trainloop_clean_run_leaves_no_dump(tmp_path):
+    loop = make_loop(tmp_path)
+    loop.run(max_steps=5)
+    assert not os.path.exists(tmp_path / "bb") or not os.listdir(tmp_path / "bb")
+
+
+def test_blackbox_off_when_telemetry_off(tmp_path):
+    cfg = Config({"blackbox_dir": str(tmp_path / "bb")})
+    loop = TrainLoop(ToyTrainer(cfg), metrics=MetricsLogger(echo=False))
+    assert loop.blackbox is None and loop.tracer is None
+    loop.run(max_steps=2)
+    assert not os.path.exists(tmp_path / "bb")

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Render the durable run ledger (RUN_LEDGER.jsonl) as a terminal report.
+
+The ledger is the append-only source of truth every bench run, training run,
+outage/probe failure, and black-box dump writes into
+(``swiftsnails_tpu/telemetry/ledger.py``); ``BENCH_LAST_GOOD.json`` is a
+derived view of it. This tool renders the history — and gates CI:
+
+    python tools/ledger_report.py                      # full history
+    python tools/ledger_report.py RUN_LEDGER.jsonl     # explicit path
+    python -m swiftsnails_tpu ledger-report            # same thing
+
+    # bench gate: exit nonzero if the newest measured run is >10% below
+    # the pinned baseline (default: best earlier measured ledger record;
+    # pin explicitly with --baseline VALUE or --baseline-file FILE)
+    python tools/ledger_report.py --check-regression 10
+
+No accelerator required; jax is only imported if the ledger is missing
+version fields (never initialized).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.telemetry.ledger import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,6 +53,10 @@ def main(argv=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from swiftsnails_tpu.utils.compat import install_pallas_compat
+
+    install_pallas_compat()
+
     print(f"devices: {jax.devices()}", flush=True)
 
     # ---- 1. unit probe ---------------------------------------------------
