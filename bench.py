@@ -125,6 +125,7 @@ _state = {
     "input_words_per_sec_production": None,  # the pipeline feeding the headline
     "platform": None,
     "at_scale": None,  # planted-pair structure at bench scale (dict)
+    "scaling": None,  # multi-chip throughput lane (dict; see measure_scaling)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -229,6 +230,7 @@ def _result_json(extra_error=None):
             ) or None,
             "platform": _state["platform"],
             "at_scale": _state["at_scale"],
+            "scaling": _state["scaling"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
             "copies_per_pair": {
@@ -764,6 +766,269 @@ def kernel_copies_per_pair(gbatches, counts, hot_n=0, u_cap=0, pc=256,
     return total_copies / max(total_pairs, 1)
 
 
+# -- scale-out throughput lane -----------------------------------------------
+#
+# The fused-grouped-mesh path measured at 1 device and at N devices (real
+# devices on TPU; `--xla_force_host_platform_device_count=8` makes the CPU
+# smoke run meaningful), per comm_dtype wire format: aggregate words/sec,
+# weak-scaling efficiency ((wps_N / N) / wps_1), audited per-collective
+# payload bytes, and a short-run loss-parity check vs f32. The block lands
+# in the result JSON line and the run ledger (`scaling`), and
+# `ledger-report --check-regression` gates on its aggregate words/sec
+# alongside the headline.
+SCALING_MIN_BUDGET_S = int(os.environ.get("SSN_SCALING_MIN_BUDGET_S", "240"))
+SCALING_COMM_DTYPES = ("float32", "bfloat16", "int8")
+SCALING_BATCH_PER_SHARD = 512 if _SMALL else 8192
+SCALING_STEPS_PER_CALL = 2 if _SMALL else 8
+SCALING_MEASURE_STEPS = 4 if _SMALL else 16
+SCALING_CALIB_STEPS = 1 if _SMALL else 4
+
+
+def _scaling_mesh_shape(n: int):
+    """(data, model) split for the lane: prefer a real model axis."""
+    model = 1
+    for cand in (4, 2):
+        if n % cand == 0 and n > cand:
+            model = cand
+            break
+    return n // model, model
+
+
+def _scaling_lane_config(vocab_size: int, dim: int, batch: int,
+                         steps_per_call: int, comm_dtype: str, overlap: bool):
+    conf = {
+        "dim": str(dim), "window": str(WINDOW), "negatives": str(NEGATIVES),
+        "learning_rate": "0.025", "batch_size": str(batch), "subsample": "0",
+        "num_iters": "1", "steps_per_call": str(steps_per_call),
+        "table_dtype": TABLE_DTYPE, "packed": "1", "neg_mode": "pool",
+        "pool_size": str(POOL_SIZE), "pool_block": str(POOL_BLOCK),
+        "fused": "1", "grouped": "1", "comm_dtype": comm_dtype,
+    }
+    if overlap:
+        conf["overlap"] = "1"
+    return conf
+
+
+def measure_scaling(counts, ids, n_devices=None, comm_dtypes=SCALING_COMM_DTYPES,
+                    dim=None, batch_per_shard=None, steps_per_call=None,
+                    measure_steps=None, calib_steps=None,
+                    include_overlap=True) -> None:
+    """Populate ``_state['scaling']`` with the multi-chip throughput lane.
+
+    Weak scaling: the per-data-shard batch is fixed, so the N-device run
+    processes ``data_axis`` x the words per dispatch; efficiency is
+    ``(wps_N / N) / wps_1x1`` with the 1-device number measured on a 1x1
+    mesh of the SAME collective plane (isolating communication cost, not a
+    plane switch). A single real device records a structured skip reason
+    instead of silently omitting the block.
+    """
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from swiftsnails_tpu.data.sampler import batch_stream, skipgram_windows
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh,
+    )
+    from swiftsnails_tpu.telemetry.audit import audit_step
+    from swiftsnails_tpu.utils.config import Config
+
+    devices = jax.devices()
+    n = min(n_devices or len(devices), len(devices))
+    dim = dim or DIM
+    b_shard = batch_per_shard or SCALING_BATCH_PER_SHARD
+    spc = steps_per_call or SCALING_STEPS_PER_CALL
+    measure_steps = measure_steps or SCALING_MEASURE_STEPS
+    calib_steps = calib_steps or SCALING_CALIB_STEPS
+    if n < 2:
+        _state["scaling"] = {
+            "skipped": f"single accelerator device (n_devices={n}); "
+                       "multi-chip lane needs >= 2 (CPU smoke: set "
+                       "--xla_force_host_platform_device_count=8)",
+            "n_devices": n,
+        }
+        _state["errors"].append("scaling lane skipped: single device")
+        return
+    data, model = _scaling_mesh_shape(n)
+    vocab_size = len(counts)
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)], np.maximum(counts, 1))
+
+    # window-schema macro batches once, at the N-device (largest) size; the
+    # 1-device lane slices the same arrays down to its smaller macro
+    rng = np.random.default_rng(17)
+    g_c, g_x = skipgram_windows(ids, WINDOW, rng)
+    macro_n = b_shard * data * spc
+    batches_n = [
+        w for w in itertools.islice(batch_stream(g_c, g_x, macro_n, rng), 6)
+        if w["centers"].shape[0] == macro_n
+    ]
+    if not batches_n:
+        _state["scaling"] = {
+            "skipped": f"corpus too small for one {macro_n}-word macro batch",
+            "n_devices": n,
+        }
+        _state["errors"].append("scaling lane skipped: corpus too small")
+        return
+
+    def run_lane(mesh, lane_batches, comm_dtype, overlap=False,
+                 want_audit=True):
+        batch = lane_batches[0]["centers"].shape[0] // spc
+        cfg = Config(_scaling_lane_config(
+            vocab_size, dim, batch, spc, comm_dtype, overlap))
+        trainer = Word2VecTrainer(
+            cfg, mesh=mesh, corpus_ids=np.zeros(2, np.int32), vocab=vocab)
+        state = trainer.init_state()
+        step = jax.jit(trainer.train_step, donate_argnums=(0,))
+        bs = batch_sharding(mesh)
+        dev_batches = [
+            {k: jax.device_put(v, bs) for k, v in b.items()}
+            for b in lane_batches
+        ]
+        key = jax.random.PRNGKey(0)
+        for i in range(2):  # compile + warm
+            state, m = step(state, dev_batches[i % len(dev_batches)],
+                            jax.random.fold_in(key, i))
+        loss = float(m["loss"])
+
+        audit_report = None
+        if want_audit:
+            try:
+                audit_report = audit_step(
+                    step, state, dev_batches[0], jax.random.fold_in(key, 0))
+            except Exception as e:
+                _state["errors"].append(
+                    f"scaling lane audit ({comm_dtype}) failed: {e}")
+
+        def timed(n_steps, base):
+            nonlocal state, m
+            t0 = time.perf_counter()
+            for i in range(n_steps):
+                state, m = step(state, dev_batches[(base + i) % len(dev_batches)],
+                                jax.random.fold_in(key, base + i))
+            _ = float(m["loss"])  # force the donated chain
+            return time.perf_counter() - t0
+
+        t_short = timed(calib_steps, 10)
+        t_long = timed(measure_steps, 20)
+        dt_diff = (t_long - t_short) / max(measure_steps - calib_steps, 1)
+        dt_ub = t_long / measure_steps
+        dt = dt_diff if (0.2 * dt_ub) < dt_diff <= dt_ub else dt_ub
+        words_per_macro = batch * spc
+        return {
+            "words_per_sec": words_per_macro / dt,
+            "step_seconds": dt,
+            "loss": loss,
+            "audit": audit_report,
+        }
+
+    def compact_bytes(audit_report):
+        if not audit_report:
+            return None, None
+        scoped = audit_report.get("by_scope", {}) or {}
+        exchange = sum(v for k, v in scoped.items()) or None
+        return audit_report.get("total_bytes"), exchange
+
+    # 1-device reference: same collective plane on a 1x1 mesh, f32 wire
+    mesh1 = make_mesh({DATA_AXIS: 1, MODEL_AXIS: 1}, devices=devices[:1])
+    macro_1 = b_shard * spc
+    batches_1 = [
+        {k: v[:macro_1] if k != "progress" else v for k, v in b.items()}
+        for b in batches_n
+    ]
+    lane1 = run_lane(mesh1, batches_1, "float32", want_audit=False)
+    wps_1 = lane1["words_per_sec"]
+
+    mesh_n = make_mesh(
+        {DATA_AXIS: data, MODEL_AXIS: model}, devices=devices[:n])
+    per_dtype = {}
+    f32_loss = None
+    f32_exchange = None
+    for comm_dtype in comm_dtypes:
+        lane = run_lane(mesh_n, batches_n, comm_dtype)
+        total_b, exchange_b = compact_bytes(lane["audit"])
+        entry = {
+            "aggregate_words_per_sec": round(lane["words_per_sec"], 1),
+            "scaling_efficiency": round(lane["words_per_sec"] / (n * wps_1), 4),
+            "step_seconds": round(lane["step_seconds"], 6),
+            "loss": _finite(lane["loss"], 6),
+            "collective_bytes_per_step": total_b,
+            "exchange_bytes_per_step": exchange_b,
+        }
+        if comm_dtype == "float32":
+            f32_loss = lane["loss"]
+            f32_exchange = exchange_b
+        else:
+            if f32_loss:
+                entry["loss_parity_vs_f32"] = _finite(
+                    abs(lane["loss"] - f32_loss) / abs(f32_loss), 6)
+            if f32_exchange and exchange_b:
+                entry["payload_reduction_vs_f32"] = round(
+                    f32_exchange / exchange_b, 3)
+        # collective-time split cross-check: audited bytes over the chip's
+        # ICI peak vs the measured step — telemetry.goodput's model-based
+        # split, recorded so an overlap/quantization win is attributable
+        if lane["audit"] is not None:
+            try:
+                from swiftsnails_tpu.telemetry.goodput import (
+                    goodput_report, peaks_for,
+                )
+
+                if _state["device_kind"] is None:
+                    _state["device_kind"] = getattr(
+                        jax.devices()[0], "device_kind", _state["platform"])
+                g = goodput_report(
+                    audit=lane["audit"], steps=1,
+                    items=int(b_shard * data * spc),
+                    step_seconds=lane["step_seconds"],
+                    peaks=peaks_for(_state["device_kind"]), n_chips=n,
+                )
+                split = g.get("step_split_est")
+                if split:
+                    entry["step_split_est"] = {
+                        k: _finite(v, 6) for k, v in split.items()
+                    }
+            except Exception as e:
+                _state["errors"].append(
+                    f"scaling lane goodput ({comm_dtype}) failed: {e}")
+        per_dtype[comm_dtype] = entry
+        print(
+            f"bench: scaling[{comm_dtype}] {n}dev "
+            f"{lane['words_per_sec']:,.0f} words/s agg "
+            f"(eff {entry['scaling_efficiency']:.2f}), "
+            f"exchange {exchange_b or 0:,} B/step",
+            file=sys.stderr,
+        )
+
+    block = {
+        "n_devices": n,
+        "mesh": {"data": data, "model": model},
+        "batch_per_shard": b_shard,
+        "steps_per_call": spc,
+        "single_device_words_per_sec": round(wps_1, 1),
+        "per_dtype": per_dtype,
+        # the gateable headline numbers (f32 lane)
+        "aggregate_words_per_sec": per_dtype["float32"]["aggregate_words_per_sec"],
+        "scaling_efficiency": per_dtype["float32"]["scaling_efficiency"],
+    }
+    if include_overlap:
+        try:
+            lane_ov = run_lane(mesh_n, batches_n, "float32", overlap=True,
+                               want_audit=False)
+            block["overlap"] = {
+                "aggregate_words_per_sec": round(lane_ov["words_per_sec"], 1),
+                "speedup_vs_sequential": round(
+                    lane_ov["words_per_sec"]
+                    / per_dtype["float32"]["aggregate_words_per_sec"], 3),
+                "loss": _finite(lane_ov["loss"], 6),
+            }
+        except Exception as e:
+            _state["errors"].append(f"scaling overlap lane failed: {e}")
+    _state["scaling"] = block
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -1196,6 +1461,16 @@ def main():
             _state["errors"].append(f"at-scale structure stage failed: {e}")
     else:
         _state["errors"].append("at-scale structure stage skipped (budget)")
+
+    # 3c. Scale-out throughput lane: the grouped-mesh path at 1 vs N devices
+    #     per comm_dtype (budget-guarded; never risks the headline).
+    if BENCH_DEADLINE_S - (time.monotonic() - _T0) >= SCALING_MIN_BUDGET_S:
+        try:
+            measure_scaling(counts, ids_train)
+        except Exception as e:
+            _state["errors"].append(f"scaling lane failed: {e}")
+    else:
+        _state["errors"].append("scaling lane skipped (budget)")
 
     # 4. Host input-pipeline rate must sustain the device rate. Never let a
     #    pipeline-measurement failure discard the measured device result.

@@ -219,14 +219,30 @@ class TrainLoop:
             self.config_hash = None
             self._want_audit = False
         self._audit_report = None
-        self._step_fn = jax.jit(trainer.train_step, donate_argnums=(0,))
+        # per-step dispatch cost trimming: the batch/replicated shardings are
+        # mesh properties — build them ONCE instead of per step, and fold the
+        # per-step RNG derivation into the jitted step itself (the step
+        # counter rides in as a uint32 array operand, so the host no longer
+        # dispatches a separate fold_in op per step and nothing retraces)
+        mesh = trainer.mesh
+        if mesh is not None and mesh.shape.get(DATA_AXIS, 1) > 1:
+            self._batch_sharding = batch_sharding(mesh)
+            self._replicated = NamedSharding(mesh, P())  # scalars (progress)
+        else:
+            self._batch_sharding = None
+            self._replicated = None
+
+        def _step(state, batch, root_rng, step):
+            rng = jax.random.fold_in(root_rng, step)
+            return trainer.train_step(state, batch, rng)
+
+        self._step_fn = jax.jit(_step, donate_argnums=(0,))
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-        mesh = self.trainer.mesh
-        if mesh is None or mesh.shape.get(DATA_AXIS, 1) == 1:
+        if self._batch_sharding is None:
             return {k: jnp.asarray(v) for k, v in batch.items()}
-        bs = batch_sharding(mesh)
-        rep = NamedSharding(mesh, P())  # scalars (e.g. lr-decay progress)
+        bs = self._batch_sharding
+        rep = self._replicated
         return {
             k: jax.device_put(v, bs if np.ndim(v) else rep)
             for k, v in batch.items()
@@ -266,8 +282,10 @@ class TrainLoop:
                     self.profiler.on_step(step)
                     with step_annotation(trainer.name, step):
                         dev_batch = self._device_batch(batch)
-                        rng = jax.random.fold_in(root_rng, step)
-                        state, last_metrics = self._step_fn(state, dev_batch, rng)
+                        # fold_in happens inside the jitted step; the numpy
+                        # scalar is an array operand (no per-value retrace)
+                        state, last_metrics = self._step_fn(
+                            state, dev_batch, root_rng, np.uint32(step))
                     step += 1
                     self.metrics.count(n_items)
                     if self.log_every and step % self.log_every == 0:
@@ -297,15 +315,15 @@ class TrainLoop:
                     with tel.step_span(trainer.name, step):
                         with tel.span("h2d"):
                             dev_batch = self._device_batch(batch)
-                        rng = jax.random.fold_in(root_rng, step)
                         if self._want_audit and self._audit_report is None:
                             # compile-only HLO audit of this exact step fn
                             # (shapes only — safe before the donated call);
                             # feeds the goodput block's FLOP/byte numerators
                             self._audit_report = self._audit_step_fn(
-                                state, dev_batch, rng)
+                                state, dev_batch, root_rng, np.uint32(step))
                         with tel.span("step", step=step):
-                            state, last_metrics = self._step_fn(state, dev_batch, rng)
+                            state, last_metrics = self._step_fn(
+                                state, dev_batch, root_rng, np.uint32(step))
                     step += 1
                     total_items += n_items
                     reg.counter("steps").inc()
@@ -368,13 +386,13 @@ class TrainLoop:
 
     # -- goodput + ledger finalization (telemetry-only paths) --------------
 
-    def _audit_step_fn(self, state, dev_batch, rng):
+    def _audit_step_fn(self, state, dev_batch, root_rng, step):
         """Compile-only HLO audit of the jitted step (never executes it);
         any failure costs only the goodput FLOP numbers, never the run."""
         try:
             from swiftsnails_tpu.telemetry.audit import audit_step
 
-            return audit_step(self._step_fn, state, dev_batch, rng)
+            return audit_step(self._step_fn, state, dev_batch, root_rng, step)
         except Exception as e:
             return {"error": f"{type(e).__name__}: {e}"}
 

@@ -111,6 +111,12 @@ class SparseCTRTrainer(Trainer):
                     tiles, self.capacity, g, model, g * model,
                 )
                 self.packed = False
+        # comm_dtype: ICI payload compression for the mesh collectives
+        # (f32 default = bit-identical; see parallel/comm.py, docs/SCALING.md)
+        from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
+
+        self.comm_dtype = resolve_comm_dtype(
+            cfg.get_str("comm_dtype", "float32"))
         self.dense_opt = (
             optax.adagrad(self.dense_lr) if opt_name == "adagrad" else optax.sgd(self.dense_lr)
         )
@@ -208,7 +214,8 @@ class SparseCTRTrainer(Trainer):
                 )
 
                 return pull_collective_packed_small(
-                    self.mesh, table_state, rows, self.table_dim
+                    self.mesh, table_state, rows, self.table_dim,
+                    comm_dtype=self.comm_dtype,
                 )
             from swiftsnails_tpu.parallel.store import pull_packed_small
 
@@ -224,7 +231,7 @@ class SparseCTRTrainer(Trainer):
 
                 return push_collective_packed_small(
                     self.mesh, table_state, rows, grads, self.access, lr,
-                    self.table_dim,
+                    self.table_dim, comm_dtype=self.comm_dtype,
                 )
             from swiftsnails_tpu.parallel.store import push_packed_small
 

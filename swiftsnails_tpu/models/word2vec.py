@@ -202,6 +202,29 @@ class Word2VecTrainer(Trainer):
                 "push_mode: bucketed requires packed: 1, and fused: 1 only "
                 "with a mesh (single-device fused has no push collective)")
         self.bucket_slack = cfg.get_float("bucket_slack", 2.0)
+        # comm_dtype: ICI payload compression for every mesh collective —
+        # f32 (default, bit-identical HLO), bf16 (~2x fewer payload bytes),
+        # int8 (per-row scale, stochastic-rounded gradients, ~3.5x). The
+        # master tables and all shard-local math stay full precision; only
+        # the all_gather/psum wire format narrows (parallel/comm.py,
+        # docs/SCALING.md). Meaningless without a mesh (no collectives).
+        from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
+
+        self.comm_dtype = resolve_comm_dtype(cfg.get_str("comm_dtype", "float32"))
+        # overlap: 1 -> software-pipelined macro-step on the grouped mesh
+        # plane: substep i's push collectives issue together with substep
+        # i+1's pull (which reads the PRE-push tables — stale-by-one reads,
+        # the reference's async-SGD semantics), so XLA can emit async
+        # -start/-done collective pairs that run under compute. Takes effect
+        # only under a mesh with steps_per_call > 1; single-device grouped
+        # runs the fused kernel unchanged.
+        self.overlap = cfg.get_bool("overlap", False)
+        if self.overlap and not (
+            cfg.get_bool("fused", False) and cfg.get_bool("grouped", False)
+        ):
+            raise ValueError(
+                "overlap: 1 requires fused: 1, grouped: 1 (the grouped "
+                "collective plane is the only overlap-scheduled path)")
 
         # stream: 1 = bounded-memory ingestion — the corpus is never
         # materialized; batches() re-opens a chunk stream each epoch
@@ -299,6 +322,23 @@ class Word2VecTrainer(Trainer):
             return hash_row(keys, self.capacity)
         return keys
 
+    def _id_cat(self, *parts):
+        """Concatenate row-id vectors; under a mesh, pin the result
+        REPLICATED. GSPMD on this jax line mis-partitions a concatenate of
+        mixed-sharded operands (data-sharded batch lineage vs replicated
+        rng/sample lineage) on a (data, model) mesh: every element arrives
+        multiplied by the model-axis size — silent garbage row ids (the
+        pre-existing grouped-mesh shape-invariance failure). Ids are tiny
+        int32 vectors, so replication costs nothing and the shard_map
+        consumers slice their P(data) shard out of it as before."""
+        out = jnp.concatenate(parts)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(self.mesh, P()))
+        return out
+
     # packed pull/push dispatch: single-device kernels, or shard_map
     # collectives wrapping the same kernels when a mesh is present
     def _ppull(self, table_state, rows):
@@ -306,9 +346,19 @@ class Word2VecTrainer(Trainer):
             return pull_packed(table_state, rows)
         from swiftsnails_tpu.parallel.transfer import pull_collective_packed
 
-        return pull_collective_packed(self.mesh, table_state, rows)
+        return pull_collective_packed(
+            self.mesh, table_state, rows, comm_dtype=self.comm_dtype)
 
-    def _ppush(self, table_state, rows, grads, lr):
+    def _comm_seed(self, rng):
+        """uint32 dither seed for int8 stochastic rounding (None unless the
+        int8 wire format is active — keeps every other path op-free)."""
+        if self.comm_dtype != "int8" or self.mesh is None:
+            return None
+        from swiftsnails_tpu.parallel.comm import seed_from_key
+
+        return seed_from_key(rng)
+
+    def _ppush(self, table_state, rows, grads, lr, seed=None):
         """Returns ``(new_table_state, dropped)`` — dropped is always 0 except
         in bucketed push mode (static bucket overflow, see transfer.py)."""
         if self.mesh is None:
@@ -320,12 +370,14 @@ class Word2VecTrainer(Trainer):
 
             return push_collective_packed_bucketed(
                 self.mesh, table_state, rows, grads, self.access, lr,
-                slack=self.bucket_slack,
+                slack=self.bucket_slack, comm_dtype=self.comm_dtype,
+                seed=seed,
             )
         from swiftsnails_tpu.parallel.transfer import push_collective_packed
 
         return push_collective_packed(
-            self.mesh, table_state, rows, grads, self.access, lr
+            self.mesh, table_state, rows, grads, self.access, lr,
+            comm_dtype=self.comm_dtype, seed=seed,
         ), jnp.int32(0)
 
     # -- data --------------------------------------------------------------
@@ -483,7 +535,7 @@ class Word2VecTrainer(Trainer):
         k = self.negatives
         negs = alias_sample(self.neg_alias, rng, (b, k))
         in_rows = self._rows(centers)
-        out_rows = self._rows(jnp.concatenate([contexts, negs.reshape(-1)]))
+        out_rows = self._rows(self._id_cat(contexts, negs.reshape(-1)))
 
         v = pull(state.in_table, in_rows)
         u = pull(state.out_table, out_rows)
@@ -520,7 +572,7 @@ class Word2VecTrainer(Trainer):
         in_rows = self._rows(centers)
         pos_rows = self._rows(contexts)
         pool_rows = self._rows(pools.reshape(-1))
-        out_rows = jnp.concatenate([pos_rows, pool_rows])
+        out_rows = self._id_cat(pos_rows, pool_rows)
 
         v = self._ppull(state.in_table, in_rows)
         u = self._ppull(state.out_table, out_rows)
@@ -542,8 +594,9 @@ class Word2VecTrainer(Trainer):
             v, u_pos, pool
         )
         du = jnp.concatenate([du_pos, dpool.reshape(-1, *dpool.shape[2:])])
-        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr)
-        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr)
+        seed = self._comm_seed(rng)
+        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr, seed=seed)
+        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr, seed=seed)
         return W2VState(in_table, out_table), loss, d1 + d2
 
     def _substep_fused(self, state: W2VState, centers, contexts, rng, lr):
@@ -676,14 +729,24 @@ class Word2VecTrainer(Trainer):
         beyond :meth:`_mesh_u_cap` overflow (zero pull / dropped grad) and
         surface in the ``dedup_dropped`` metric (``push_dropped`` when
         combined with bucketed push, which subsumes the push-side dedup).
+
+        Split into :meth:`_pull_grouped_mesh` + :meth:`_push_grouped_mesh`
+        so the ``overlap: 1`` macro-step can pipeline substep i's push with
+        substep i+1's pull (see :meth:`_overlap_macro`).
         """
+        pulled = self._pull_grouped_mesh(state, centers, ctxs, rng)
+        return self._push_grouped_mesh(state, pulled, lr)
+
+    def _pull_grouped_mesh(self, state: W2VState, centers, ctxs, rng):
+        """Pull half of the grouped collective substep: sample pools, build
+        the row sets, pull both tables. Returns the ``pulled`` bundle the
+        push half consumes (a pytree with config-static structure, so it can
+        ride a ``lax.scan`` carry for the overlap schedule)."""
         n = centers.shape[0]
         cw = ctxs.shape[1]
         pc = self._effective_pc(n)
         nb = n // pc
         pn = self.pool_size
-        lam = self.negatives / pn
-        inv_b = 1.0 / (n * (self.window + 1))
         pools = alias_sample(self.neg_alias, rng, (nb, pn))
 
         cap = self.capacity
@@ -693,18 +756,36 @@ class Word2VecTrainer(Trainer):
         mask = (ctxs >= 0).astype(jnp.float32)  # [n, cw]
 
         v = self._ppull(state.in_table, center_rows)  # [n, S, L]
-        out_pull_rows = jnp.concatenate([ctx_rows.reshape(-1), pool_rows])
+        out_pull_rows = self._id_cat(ctx_rows.reshape(-1), pool_rows)
         d_pull = jnp.int32(0)
+        u_index = None
         if self.dedup:
             from swiftsnails_tpu.parallel.transfer import (
                 pull_collective_packed_dedup,
             )
 
-            ucap = self._mesh_u_cap(n)
             u_all, u_index, d_pull = pull_collective_packed_dedup(
-                self.mesh, state.out_table, out_pull_rows, ucap)
+                self.mesh, state.out_table, out_pull_rows, self._mesh_u_cap(n),
+                comm_dtype=self.comm_dtype)
         else:
             u_all = self._ppull(state.out_table, out_pull_rows)
+        seed = self._comm_seed(rng)
+        return (center_rows, out_pull_rows, mask, v, u_all, u_index, d_pull,
+                seed)
+
+    def _push_grouped_mesh(self, state: W2VState, pulled, lr):
+        """Push half: SGNS loss/grads on the pulled rows, merged push of both
+        tables. Shapes/constants rederive from the bundle, so the math is
+        identical whether it runs fused with its own pull (plain substep) or
+        against a one-substep-stale pull (overlap schedule)."""
+        (center_rows, out_pull_rows, mask, v, u_all, u_index, d_pull,
+         seed) = pulled
+        n, cw = mask.shape
+        pc = self._effective_pc(n)
+        nb = n // pc
+        pn = self.pool_size
+        lam = self.negatives / pn
+        inv_b = 1.0 / (n * (self.window + 1))
         u = u_all[: n * cw].reshape((n, cw) + u_all.shape[1:])
         q = u_all[n * cw :].reshape((nb, pn) + u_all.shape[1:])
 
@@ -725,7 +806,8 @@ class Word2VecTrainer(Trainer):
             [du.reshape((n * cw,) + du.shape[2:]),
              dq.reshape((nb * pn,) + dq.shape[2:])]
         )
-        in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr)
+        in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr,
+                                   seed=seed)
         if self.dedup and self.push_mode != "bucketed":
             from swiftsnails_tpu.parallel.transfer import (
                 push_collective_packed_dedup,
@@ -735,11 +817,42 @@ class Word2VecTrainer(Trainer):
             # keeps the overflow metric single-counted (d2 is 0 here)
             out_table, d2 = push_collective_packed_dedup(
                 self.mesh, state.out_table, out_pull_rows, out_grads,
-                self.access, lr, ucap, index=u_index)
+                self.access, lr, self._mesh_u_cap(n), index=u_index,
+                comm_dtype=self.comm_dtype, seed=seed)
         else:
             out_table, d2 = self._ppush(state.out_table, out_pull_rows,
-                                        out_grads, lr)
+                                        out_grads, lr, seed=seed)
         return W2VState(in_table, out_table), loss, d_pull + d1 + d2
+
+    def _overlap_macro(self, state: W2VState, c, x, keys, lr):
+        """Software-pipelined macro-step over the grouped mesh plane
+        (``overlap: 1``): each scan iteration issues substep i+1's pull
+        against the PRE-push tables and substep i's push with no data
+        dependence between the two, so XLA is free to emit async
+        ``-start``/``-done`` collective pairs that run the push all_gather
+        under the next pull + compute (the 2204.06514 overlap lever).
+
+        Semantics: substep i >= 1 reads rows that miss substep i-1's update
+        — stale-by-one async SGD, the reference worker's pipeline behavior
+        (pull for the next batch outstanding while the push callback is in
+        flight, transfer.h:55-268). The final iteration prefetches substep 0
+        again to keep shapes static; that pull is discarded (1/t overhead).
+        """
+        t = c.shape[0]
+        pulled0 = self._pull_grouped_mesh(state, c[0], x[0], keys[0])
+        nxt = (jnp.roll(c, -1, axis=0), jnp.roll(x, -1, axis=0),
+               jnp.roll(keys, -1, axis=0))
+
+        def body(carry, xs):
+            st, pulled = carry
+            cn, xn, kn = xs
+            pulled_next = self._pull_grouped_mesh(st, cn, xn, kn)
+            st, loss, dropped = self._push_grouped_mesh(st, pulled, lr)
+            return (st, pulled_next), (loss, dropped)
+
+        (state, _), (losses, drops) = jax.lax.scan(
+            body, (state, pulled0), nxt)
+        return state, losses, drops
 
     def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
         """Packed tables with reference-faithful per-pair K negatives."""
@@ -747,7 +860,7 @@ class Word2VecTrainer(Trainer):
         k = self.negatives
         negs = alias_sample(self.neg_alias, rng, (b, k))
         in_rows = self._rows(centers)
-        out_rows = self._rows(jnp.concatenate([contexts, negs.reshape(-1)]))
+        out_rows = self._rows(self._id_cat(contexts, negs.reshape(-1)))
 
         v = self._ppull(state.in_table, in_rows)
         u = self._ppull(state.out_table, out_rows)
@@ -765,8 +878,9 @@ class Word2VecTrainer(Trainer):
             v, u_pos, u_neg
         )
         du = jnp.concatenate([du_pos, du_neg.reshape(-1, *du_neg.shape[2:])])
-        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr)
-        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr)
+        seed = self._comm_seed(rng)
+        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr, seed=seed)
+        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr, seed=seed)
         return W2VState(in_table, out_table), loss, d1 + d2
 
     def train_step(self, state: W2VState, batch, rng):
@@ -817,17 +931,22 @@ class Word2VecTrainer(Trainer):
             state, loss, dropped = substep(state, centers, contexts, rng, lr)
             return state, metrics_of(loss, dropped)
 
+        keys = jax.random.split(rng, t)
+        c_t = centers.reshape(t, b)
+        x_t = contexts.reshape((t, b) + contexts.shape[1:])
+        on_grouped_mesh = (
+            self.fused and self.grouped and self.mesh is not None
+        )
+        if self.overlap and on_grouped_mesh:
+            state, losses, drops = self._overlap_macro(state, c_t, x_t, keys, lr)
+            return state, metrics_of(losses.mean(), drops.sum())
+
         def body(st, xs):
             c, x, key = xs
             st, loss, dropped = substep(st, c, x, key, lr)
             return st, (loss, dropped)
 
-        keys = jax.random.split(rng, t)
-        state, (losses, drops) = jax.lax.scan(
-            body, state,
-            (centers.reshape(t, b),
-             contexts.reshape((t, b) + contexts.shape[1:]), keys),
-        )
+        state, (losses, drops) = jax.lax.scan(body, state, (c_t, x_t, keys))
         return state, metrics_of(losses.mean(), drops.sum())
 
     # -- export (ServerTerminate parity: text dump of the table) -----------

@@ -1,5 +1,6 @@
 from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, table_sharding
 from swiftsnails_tpu.parallel.access import AccessMethod, SgdAccess, AdaGradAccess
+from swiftsnails_tpu.parallel.comm import COMM_DTYPES, resolve_comm_dtype
 from swiftsnails_tpu.parallel.store import (
     TableState,
     create_table,
@@ -9,6 +10,8 @@ from swiftsnails_tpu.parallel.store import (
 )
 
 __all__ = [
+    "COMM_DTYPES",
+    "resolve_comm_dtype",
     "DATA_AXIS",
     "MODEL_AXIS",
     "make_mesh",

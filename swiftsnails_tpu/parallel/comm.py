@@ -1,0 +1,211 @@
+"""Quantized collective payloads — the ``comm_dtype`` codec.
+
+The reference's wire format was already narrower than its math: the push
+message serialized ``(key, grad)`` pairs per server with no requirement that
+the grad bytes match the table's storage precision (survey §2.3). Here the
+same idea applies to the ICI payload of every pull/push collective in
+:mod:`swiftsnails_tpu.parallel.transfer`: quantize just before the
+``all_gather`` / ``psum``, dequantize into f32 accumulation at the owner
+shard, master table untouched. EQuARX (arXiv 2506.17615) measures this
+recovering most of the interconnect-bandwidth cost of scale-out collectives
+at negligible quality loss.
+
+Three wire formats (config key ``comm_dtype``):
+
+* ``float32`` (default) — no codec; the collectives are **bit-identical** to
+  a build without this module (the transfer functions never call in here).
+* ``bfloat16`` — payload cast; ~2x byte cut, exponent range preserved. The
+  payload moves as **bitcast uint16**: backends whose float-normalization
+  pass would silently promote a bf16 collective back to f32 (CPU does —
+  the ``convert_convert_fusion`` pattern re-widens the wire format and
+  erases the byte cut) leave integer collectives alone, and for the
+  owner-exclusive psum the integer add of one nonzero contribution plus
+  zeros is exact — bit-for-bit the bf16 value, with no second rounding.
+* ``int8``   — per-row symmetric scale (``amax/127`` over the trailing
+  axes); ~3.5x byte cut (the f32 scale vector rides alongside, 1 scalar per
+  row). Gradients are **stochastically rounded** so the quantizer is
+  unbiased: ``E[dequant(quant(g))] = g`` — plain round-to-nearest would bias
+  small persistent gradient components to zero across steps.
+
+Two collective patterns are wrapped, matching the two protocols:
+
+* :func:`psum_quantized` — the pull protocol's assemble-rows reduction. Each
+  row position is nonzero on exactly ONE shard (the owner; everyone else
+  contributes zeros), so quantizing per shard and reducing payload + scale
+  separately is exact: the zero rows carry zero scale, and the sum passes
+  the owner's ``(q, scale)`` through untouched. int8 sums cannot overflow
+  (one nonzero contribution per position).
+* :func:`all_gather_quantized` — the push protocol's batch movement. The
+  gather is lossless w.r.t. its operand, so the only error is the one
+  quantization step on the sender.
+
+Stochastic rounding uses a counter-based integer hash (no PRNG key plumbing
+through ``shard_map``): a ``uint32`` seed operand is combined with the
+element index and the data shard's ``axis_index``, avalanched, and mapped to
+a uniform in ``[0, 1)``. Deterministic given (seed, position, shard) — the
+same trace replays identically — while unbiased over positions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+COMM_DTYPES = ("float32", "bfloat16", "int8")
+
+_GOLDEN = np.uint32(0x9E3779B9)  # Weyl increment for the seed stream
+
+
+def resolve_comm_dtype(name: Optional[str]) -> str:
+    """Validate / canonicalize a ``comm_dtype`` config value."""
+    if not name:
+        return "float32"
+    canon = {"float32": "float32", "f32": "float32",
+             "bfloat16": "bfloat16", "bf16": "bfloat16",
+             "int8": "int8", "s8": "int8"}.get(str(name).strip().lower())
+    if canon is None:
+        raise ValueError(
+            f"comm_dtype must be one of {COMM_DTYPES}, got {name!r}")
+    return canon
+
+
+def seed_from_key(key) -> Optional[jax.Array]:
+    """uint32 stochastic-rounding seed from a jax PRNG key (``None`` -> None).
+
+    Works for both raw ``uint32[2]`` keys and new-style typed keys; only the
+    low word is used (the fold_in stream already decorrelates steps).
+    """
+    if key is None:
+        return None
+    try:
+        data = jax.random.key_data(key)
+    except (AttributeError, TypeError):
+        data = jnp.asarray(key)
+    return data.reshape(-1)[-1].astype(jnp.uint32)
+
+
+def _hash_uniform(shape, seed) -> jax.Array:
+    """Deterministic uniform[0,1) noise from (element index, seed).
+
+    lowbias32-style avalanche over a position iota — cheap, vectorized, and
+    trace-friendly (no key threading); quality is far beyond what dithered
+    rounding needs.
+    """
+    n = int(np.prod(shape)) if shape else 1
+    x = lax.iota(jnp.uint32, max(n, 1))
+    x = x * jnp.uint32(2654435761) + seed.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    u = x.astype(jnp.float32) * jnp.float32(1.0 / 4294967296.0)
+    return u[:n].reshape(shape)
+
+
+def _salted(seed, axis_name: Optional[str]) -> jax.Array:
+    """Mix the data-shard index into the seed so shards draw distinct noise.
+    Must be called inside ``shard_map`` when ``axis_name`` is given."""
+    s = jnp.uint32(0) if seed is None else jnp.asarray(seed, jnp.uint32)
+    if axis_name is not None:
+        s = s + lax.axis_index(axis_name).astype(jnp.uint32) * _GOLDEN
+    return s
+
+
+def _bf16_wire(x: jax.Array) -> jax.Array:
+    """bf16 payload as bitcast uint16 (collective-safe on every backend)."""
+    return lax.bitcast_convert_type(x.astype(jnp.bfloat16), jnp.uint16)
+
+
+def _bf16_unwire(w: jax.Array, dtype) -> jax.Array:
+    return lax.bitcast_convert_type(w, jnp.bfloat16).astype(dtype)
+
+
+def quantize_int8(
+    x: jax.Array, stochastic: bool = False, seed=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8: returns ``(q [x.shape] int8, scale [N] f32)``.
+
+    Row = leading axis; scale is ``amax/127`` over the trailing axes and 0
+    for all-zero rows (so zero contributions stay exactly zero through a
+    reduction — the owner-exclusive psum relies on this).
+    """
+    red = tuple(range(1, x.ndim))
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=red) if red else jnp.abs(xf)
+    scale = (amax * jnp.float32(1.0 / 127.0)).astype(jnp.float32)
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    y = xf * inv.reshape((-1,) + (1,) * (x.ndim - 1))
+    if stochastic:
+        y = jnp.floor(y + _hash_uniform(y.shape, jnp.uint32(0) if seed is None
+                                        else seed))
+    else:
+        y = jnp.round(y)
+    q = jnp.clip(y, -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8 payload + per-row scale -> f32 (the owner-side accumulation
+    dtype; callers cast to the table dtype if they need to)."""
+    return q.astype(jnp.float32) * scale.reshape(
+        (-1,) + (1,) * (q.ndim - 1)).astype(jnp.float32)
+
+
+def psum_quantized(vals: jax.Array, axis_name: str, comm_dtype: str) -> jax.Array:
+    """Pull-protocol reduction with a compressed payload.
+
+    ``vals`` must be owner-exclusive: each leading-axis position is nonzero
+    on at most one shard of ``axis_name`` (the collective planes mask
+    non-owned rows to zero before reducing). f32 passes straight through to
+    ``lax.psum`` — bit-identical to the pre-codec path.
+    """
+    if comm_dtype == "float32":
+        return lax.psum(vals, axis_name)
+    if comm_dtype == "bfloat16":
+        # owner-exclusive: the u16 integer sum of one nonzero contribution
+        # plus zero words IS the owner's bf16 bit pattern (0.0 bitcasts to
+        # 0x0000), so the bitcast wire format loses nothing beyond the one
+        # f32->bf16 rounding
+        out = lax.psum(_bf16_wire(vals), axis_name)
+        return _bf16_unwire(out, vals.dtype)
+    # int8: owner-exclusive rows -> the sum of (q, scale) pairs IS the
+    # owner's pair (zeros elsewhere carry zero scale); no overflow possible
+    q, scale = quantize_int8(vals)
+    q_sum = lax.psum(q.astype(jnp.int8), axis_name)
+    s_sum = lax.psum(scale, axis_name)
+    return dequantize_int8(q_sum, s_sum).astype(vals.dtype)
+
+
+def all_gather_quantized(
+    x: jax.Array,
+    axis_name: str,
+    comm_dtype: str,
+    stochastic: bool = False,
+    seed=None,
+) -> jax.Array:
+    """Push-protocol movement with a compressed payload (tiled all_gather).
+
+    ``stochastic=True`` dithers the int8 rounding (gradients); ``seed`` is a
+    replicated uint32 scalar — it is salted with this shard's data-axis
+    index so shards draw independent noise.
+    """
+    if comm_dtype == "float32":
+        return lax.all_gather(x, axis_name, tiled=True)
+    if comm_dtype == "bfloat16":
+        out = lax.all_gather(_bf16_wire(x), axis_name, tiled=True)
+        return _bf16_unwire(
+            out, jnp.float32 if x.dtype == jnp.float32 else x.dtype)
+    q, scale = quantize_int8(
+        x, stochastic=stochastic,
+        seed=_salted(seed, axis_name) if stochastic else None,
+    )
+    q_all = lax.all_gather(q, axis_name, tiled=True)
+    s_all = lax.all_gather(scale, axis_name, tiled=True)
+    return dequantize_int8(q_all, s_all).astype(
+        jnp.float32 if x.dtype == jnp.float32 else x.dtype)

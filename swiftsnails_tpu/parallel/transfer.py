@@ -36,8 +36,31 @@ from jax.sharding import Mesh, PartitionSpec as P
 from swiftsnails_tpu.utils.compat import shard_map
 
 from swiftsnails_tpu.parallel.access import AccessMethod
+from swiftsnails_tpu.parallel.comm import (
+    all_gather_quantized,
+    psum_quantized,
+    resolve_comm_dtype,
+)
 from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from swiftsnails_tpu.parallel.store import TableState, apply_rows, merge_duplicate_rows
+
+# Payload compression (``comm_dtype`` kwarg on every collective below): the
+# (rows, grads) / assembled-row payloads quantize JUST before the
+# all_gather/psum and dequantize into f32 accumulation at the owner shard —
+# the master table and all shard-local math stay full precision. "float32"
+# (the default) takes the original code path untouched, so existing callers
+# are bit-identical. See parallel/comm.py for the wire formats and
+# docs/SCALING.md for semantics; the int8 ``seed`` operand drives the
+# stochastic rounding of gradients (replicated uint32 scalar, salted with
+# the data-shard index inside the codec).
+
+
+def _seed_operand(comm_dtype: str, seed):
+    """(extra_args, extra_specs) for the optional int8 dither seed."""
+    if comm_dtype != "int8":
+        return (), ()
+    s = jnp.uint32(0) if seed is None else jnp.asarray(seed).astype(jnp.uint32)
+    return (s,), (P(),)
 
 
 def _rows_per_shard(capacity: int, mesh: Mesh) -> int:
@@ -84,9 +107,13 @@ def _compact_owned(uniq, merged, m, per, cap, invalid):
     return b_rows, b_grads, overflow
 
 
-def pull_collective(mesh: Mesh, state: TableState, rows: jax.Array) -> jax.Array:
+def pull_collective(
+    mesh: Mesh, state: TableState, rows: jax.Array,
+    comm_dtype: str = "float32",
+) -> jax.Array:
     """Sharded gather with explicit psum-over-model (pull protocol)."""
     per = _rows_per_shard(state.capacity, mesh)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
 
     def local_pull(table_shard, rows_local):
         m = lax.axis_index(MODEL_AXIS)
@@ -94,7 +121,7 @@ def pull_collective(mesh: Mesh, state: TableState, rows: jax.Array) -> jax.Array
         owned = (local_ids >= 0) & (local_ids < per)
         vals = table_shard.at[jnp.where(owned, local_ids, 0)].get(mode="promise_in_bounds")
         vals = jnp.where(owned[:, None], vals, 0)
-        return lax.psum(vals, MODEL_AXIS)
+        return psum_quantized(vals, MODEL_AXIS, comm_dtype)
 
     fn = shard_map(
         local_pull,
@@ -115,6 +142,8 @@ def push_collective(
     access: AccessMethod,
     lr,
     exact: bool = False,
+    comm_dtype: str = "float32",
+    seed=None,
 ) -> TableState:
     """Sharded scatter-update with explicit all_gather-over-data (push protocol).
 
@@ -122,11 +151,15 @@ def push_collective(
     store.push`, applied per model shard, so both data planes stay equivalent.
     """
     per = _rows_per_shard(state.capacity, mesh)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     slot_keys = sorted(state.slots.keys())
+    extra, extra_specs = _seed_operand(comm_dtype, seed)
 
-    def local_push(table_shard, slot_shards, rows_local, grads_local):
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *dither):
         rows_all = lax.all_gather(rows_local, DATA_AXIS, tiled=True)
-        grads_all = lax.all_gather(grads_local, DATA_AXIS, tiled=True)
+        grads_all = all_gather_quantized(
+            grads_local, DATA_AXIS, comm_dtype, stochastic=True,
+            seed=dither[0] if dither else None)
         m = lax.axis_index(MODEL_AXIS)
         local_ids = rows_all - m * per
         owned = (local_ids >= 0) & (local_ids < per)
@@ -143,12 +176,13 @@ def push_collective(
     fn = shard_map(
         local_push,
         mesh=mesh,
-        in_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
+                  P(DATA_AXIS), P(DATA_AXIS)) + extra_specs,
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
         check_vma=False,
     )
     with jax.named_scope("ssn_push_collective"):
-        table, slots = fn(state.table, dict(state.slots), rows, grads)
+        table, slots = fn(state.table, dict(state.slots), rows, grads, *extra)
     return TableState(table=table, slots=slots)
 
 
@@ -162,11 +196,14 @@ def push_collective(
 # batch over `data` and every model shard updates only the rows it owns.
 
 
-def pull_collective_packed(mesh: Mesh, state, rows: jax.Array) -> jax.Array:
+def pull_collective_packed(
+    mesh: Mesh, state, rows: jax.Array, comm_dtype: str = "float32",
+) -> jax.Array:
     """Sharded packed gather -> [N, S, 128] (pull protocol)."""
     from swiftsnails_tpu.parallel.store import PackedTableState, pull_packed
 
     per = _rows_per_shard(state.capacity, mesh)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
 
     def local_pull(table_shard, rows_local):
         m = lax.axis_index(MODEL_AXIS)
@@ -175,7 +212,7 @@ def pull_collective_packed(mesh: Mesh, state, rows: jax.Array) -> jax.Array:
         shard_state = PackedTableState(table=table_shard, slots={})
         vals = pull_packed(shard_state, jnp.where(owned, local_ids, 0))
         vals = jnp.where(owned[:, None, None], vals, 0)
-        return lax.psum(vals, MODEL_AXIS)
+        return psum_quantized(vals, MODEL_AXIS, comm_dtype)
 
     fn = shard_map(
         local_pull,
@@ -195,16 +232,22 @@ def push_collective_packed(
     grads: jax.Array,
     access: AccessMethod,
     lr,
+    comm_dtype: str = "float32",
+    seed=None,
 ):
     """Sharded packed push: all_gather over data, row-DMA update of owned rows."""
     from swiftsnails_tpu.parallel.store import PackedTableState, push_packed
 
     per = _rows_per_shard(state.capacity, mesh)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     slot_keys = sorted(state.slots.keys())
+    extra, extra_specs = _seed_operand(comm_dtype, seed)
 
-    def local_push(table_shard, slot_shards, rows_local, grads_local):
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *dither):
         rows_all = lax.all_gather(rows_local, DATA_AXIS, tiled=True)
-        grads_all = lax.all_gather(grads_local, DATA_AXIS, tiled=True)
+        grads_all = all_gather_quantized(
+            grads_local, DATA_AXIS, comm_dtype, stochastic=True,
+            seed=dither[0] if dither else None)
         m = lax.axis_index(MODEL_AXIS)
         local_ids = rows_all - m * per
         owned = (local_ids >= 0) & (local_ids < per)
@@ -219,12 +262,12 @@ def push_collective_packed(
         local_push,
         mesh=mesh,
         in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
-                  P(DATA_AXIS), P(DATA_AXIS)),
+                  P(DATA_AXIS), P(DATA_AXIS)) + extra_specs,
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
         check_vma=False,
     )
     with jax.named_scope("ssn_push_collective_packed"):
-        table, slots = fn(state.table, dict(state.slots), rows, grads)
+        table, slots = fn(state.table, dict(state.slots), rows, grads, *extra)
     return PackedTableState(table=table, slots=slots)
 
 
@@ -258,12 +301,14 @@ def _tiles_per_shard(state, mesh: Mesh, dim: int) -> tuple:
 
 
 def pull_collective_packed_small(
-    mesh: Mesh, state, rows: jax.Array, dim: int
+    mesh: Mesh, state, rows: jax.Array, dim: int,
+    comm_dtype: str = "float32",
 ) -> jax.Array:
     """Sharded small-row gather -> [N, dim] (pull protocol)."""
     from swiftsnails_tpu.parallel.store import PackedTableState, pull_packed_small
 
     _, per_rows, _ = _tiles_per_shard(state, mesh, dim)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
 
     def local_pull(table_shard, rows_local):
         m = lax.axis_index(MODEL_AXIS)
@@ -273,7 +318,7 @@ def pull_collective_packed_small(
         vals = pull_packed_small(
             shard_state, jnp.where(owned, local_ids, 0), dim)
         vals = jnp.where(owned[:, None], vals, 0)
-        return lax.psum(vals, MODEL_AXIS)
+        return psum_quantized(vals, MODEL_AXIS, comm_dtype)
 
     fn = shard_map(
         local_pull,
@@ -294,16 +339,22 @@ def push_collective_packed_small(
     access: AccessMethod,
     lr,
     dim: int,
+    comm_dtype: str = "float32",
+    seed=None,
 ):
     """Sharded small-row push: all_gather over data, fused RMW of owned rows."""
     from swiftsnails_tpu.parallel.store import PackedTableState, push_packed_small
 
     _, per_rows, _ = _tiles_per_shard(state, mesh, dim)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     slot_keys = sorted(state.slots.keys())
+    extra, extra_specs = _seed_operand(comm_dtype, seed)
 
-    def local_push(table_shard, slot_shards, rows_local, grads_local):
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *dither):
         rows_all = lax.all_gather(rows_local, DATA_AXIS, tiled=True)
-        grads_all = lax.all_gather(grads_local, DATA_AXIS, tiled=True)
+        grads_all = all_gather_quantized(
+            grads_local, DATA_AXIS, comm_dtype, stochastic=True,
+            seed=dither[0] if dither else None)
         m = lax.axis_index(MODEL_AXIS)
         local_ids = rows_all - m * per_rows
         owned = (local_ids >= 0) & (local_ids < per_rows)
@@ -320,12 +371,12 @@ def push_collective_packed_small(
         local_push,
         mesh=mesh,
         in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
-                  P(DATA_AXIS), P(DATA_AXIS)),
+                  P(DATA_AXIS), P(DATA_AXIS)) + extra_specs,
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}),
         check_vma=False,
     )
     with jax.named_scope("ssn_push_collective_packed_small"):
-        table, slots = fn(state.table, dict(state.slots), rows, grads)
+        table, slots = fn(state.table, dict(state.slots), rows, grads, *extra)
     return PackedTableState(table=table, slots=slots)
 
 
@@ -365,21 +416,27 @@ def push_collective_bucketed(
     access: AccessMethod,
     lr,
     slack: float = 2.0,
+    comm_dtype: str = "float32",
+    seed=None,
 ):
     """Owner-bucketed sharded push. Returns ``(new_state, dropped)``."""
     per = _rows_per_shard(state.capacity, mesh)
     model = mesh.shape[MODEL_AXIS]
     local_n = rows.shape[0] // mesh.shape[DATA_AXIS]
     cap = bucket_capacity(local_n, model, slack)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     slot_keys = sorted(state.slots.keys())
     invalid = state.capacity
+    extra, extra_specs = _seed_operand(comm_dtype, seed)
 
-    def local_push(table_shard, slot_shards, rows_local, grads_local):
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *dither):
         m = lax.axis_index(MODEL_AXIS)
         uniq_l, merged_l = merge_duplicate_rows(rows_local, grads_local, invalid_row=invalid)
         b_rows, b_grads, overflow = _compact_owned(uniq_l, merged_l, m, per, cap, invalid)
         rows_all = lax.all_gather(b_rows, DATA_AXIS, tiled=True)
-        grads_all = lax.all_gather(b_grads, DATA_AXIS, tiled=True)
+        grads_all = all_gather_quantized(
+            b_grads, DATA_AXIS, comm_dtype, stochastic=True,
+            seed=dither[0] if dither else None)
         local_ids = rows_all - m * per  # all owned-by-m or invalid padding
         owned = (local_ids >= 0) & (local_ids < per)
         local_ids = jnp.where(owned, local_ids, per)
@@ -392,12 +449,14 @@ def push_collective_bucketed(
     fn = shard_map(
         local_push,
         mesh=mesh,
-        in_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
+                  P(DATA_AXIS), P(DATA_AXIS)) + extra_specs,
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
         check_vma=False,
     )
     with jax.named_scope("ssn_push_collective_bucketed"):
-        table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
+        table, slots, dropped = fn(state.table, dict(state.slots), rows, grads,
+                                   *extra)
     return TableState(table=table, slots=slots), dropped
 
 
@@ -451,7 +510,8 @@ def _unique_static(rows: jax.Array, cap: int, invalid: int):
 
 
 def pull_collective_packed_dedup(
-    mesh: Mesh, state, rows: jax.Array, u_cap: int
+    mesh: Mesh, state, rows: jax.Array, u_cap: int,
+    comm_dtype: str = "float32",
 ):
     """Dedup'd sharded packed gather (pull protocol over a unique list).
 
@@ -464,6 +524,7 @@ def pull_collective_packed_dedup(
     from swiftsnails_tpu.parallel.store import PackedTableState, pull_packed
 
     per = _rows_per_shard(state.capacity, mesh)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     invalid = state.capacity
 
     def local_pull(table_shard, rows_local):
@@ -474,7 +535,7 @@ def pull_collective_packed_dedup(
         shard_state = PackedTableState(table=table_shard, slots={})
         vals = pull_packed(shard_state, jnp.where(owned, local_ids, 0))
         vals = jnp.where(owned[:, None, None], vals, 0)
-        vals = lax.psum(vals, MODEL_AXIS)  # [u_cap, S, L] assembled rows
+        vals = psum_quantized(vals, MODEL_AXIS, comm_dtype)  # [u_cap, S, L]
         # expand unique rows back to their slots; overflow slots (inv ==
         # u_cap) read the appended zero row
         vals = jnp.concatenate(
@@ -503,6 +564,8 @@ def push_collective_packed_dedup(
     lr,
     u_cap: int,
     index=None,
+    comm_dtype: str = "float32",
+    seed=None,
 ):
     """Sender-dedup'd packed push: duplicates merge into the unique list
     BEFORE the all_gather over ``data``. Returns ``(new_state, dropped)``.
@@ -515,10 +578,14 @@ def push_collective_packed_dedup(
     from swiftsnails_tpu.parallel.store import PackedTableState, push_packed
 
     per = _rows_per_shard(state.capacity, mesh)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     slot_keys = sorted(state.slots.keys())
     invalid = state.capacity
+    extra, extra_specs = _seed_operand(comm_dtype, seed)
 
-    def local_push(table_shard, slot_shards, rows_local, grads_local, *idx):
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *rest):
+        dither = rest[-1:] if extra else ()
+        idx = rest[: len(rest) - len(dither)]
         if idx:
             uniq, inv = idx
             overflow = jnp.int32(0)
@@ -529,7 +596,9 @@ def push_collective_packed_dedup(
             (u_cap,) + grads_local.shape[1:], grads_local.dtype
         ).at[inv].add(grads_local, mode="drop")
         rows_all = lax.all_gather(uniq, DATA_AXIS, tiled=True)
-        grads_all = lax.all_gather(merged, DATA_AXIS, tiled=True)
+        grads_all = all_gather_quantized(
+            merged, DATA_AXIS, comm_dtype, stochastic=True,
+            seed=dither[0] if dither else None)
         m = lax.axis_index(MODEL_AXIS)
         local_ids = rows_all - m * per
         owned = (local_ids >= 0) & (local_ids < per)
@@ -546,13 +615,13 @@ def push_collective_packed_dedup(
         local_push,
         mesh=mesh,
         in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
-                  P(DATA_AXIS), P(DATA_AXIS)) + idx_specs,
+                  P(DATA_AXIS), P(DATA_AXIS)) + idx_specs + extra_specs,
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
         check_vma=False,
     )
     with jax.named_scope("ssn_push_collective_packed_dedup"):
         table, slots, dropped = fn(
-            state.table, dict(state.slots), rows, grads, *idx_args)
+            state.table, dict(state.slots), rows, grads, *idx_args, *extra)
     return PackedTableState(table=table, slots=slots), dropped
 
 
@@ -564,6 +633,8 @@ def push_collective_packed_bucketed(
     access: AccessMethod,
     lr,
     slack: float = 2.0,
+    comm_dtype: str = "float32",
+    seed=None,
 ):
     """Owner-bucketed packed push ([N, S, 128] grads). Returns ``(state, dropped)``."""
     from swiftsnails_tpu.parallel.store import PackedTableState, push_packed
@@ -572,15 +643,19 @@ def push_collective_packed_bucketed(
     model = mesh.shape[MODEL_AXIS]
     local_n = rows.shape[0] // mesh.shape[DATA_AXIS]
     cap = bucket_capacity(local_n, model, slack)
+    comm_dtype = resolve_comm_dtype(comm_dtype)
     slot_keys = sorted(state.slots.keys())
     invalid = state.capacity
+    extra, extra_specs = _seed_operand(comm_dtype, seed)
 
-    def local_push(table_shard, slot_shards, rows_local, grads_local):
+    def local_push(table_shard, slot_shards, rows_local, grads_local, *dither):
         m = lax.axis_index(MODEL_AXIS)
         uniq_l, merged_l = merge_duplicate_rows(rows_local, grads_local, invalid_row=invalid)
         b_rows, b_grads, overflow = _compact_owned(uniq_l, merged_l, m, per, cap, invalid)
         rows_all = lax.all_gather(b_rows, DATA_AXIS, tiled=True)
-        grads_all = lax.all_gather(b_grads, DATA_AXIS, tiled=True)
+        grads_all = all_gather_quantized(
+            b_grads, DATA_AXIS, comm_dtype, stochastic=True,
+            seed=dither[0] if dither else None)
         local_ids = rows_all - m * per
         owned = (local_ids >= 0) & (local_ids < per)
         local_ids = jnp.where(owned, local_ids, per)
@@ -595,10 +670,11 @@ def push_collective_packed_bucketed(
         local_push,
         mesh=mesh,
         in_specs=(shard_spec, {k: shard_spec for k in slot_keys},
-                  P(DATA_AXIS), P(DATA_AXIS)),
+                  P(DATA_AXIS), P(DATA_AXIS)) + extra_specs,
         out_specs=(shard_spec, {k: shard_spec for k in slot_keys}, P()),
         check_vma=False,
     )
     with jax.named_scope("ssn_push_collective_packed_bucketed"):
-        table, slots, dropped = fn(state.table, dict(state.slots), rows, grads)
+        table, slots, dropped = fn(state.table, dict(state.slots), rows, grads,
+                                   *extra)
     return PackedTableState(table=table, slots=slots), dropped

@@ -227,9 +227,15 @@ def goodput_report(
             if coll_bytes and peaks.get("ici_bytes_per_s") else None
         )
         if comp_est is not None or coll_est is not None:
+            # the seconds estimates are kept alongside the fractions so the
+            # collective share can be cross-checked directly against the
+            # audited bytes / ICI peak (the scale-out lane records both and
+            # attributes an overlap/quantization win to the right term)
             report["step_split_est"] = {
                 "compute_frac": (comp_est or 0.0) / step_seconds,
                 "collective_frac": (coll_est or 0.0) / step_seconds,
+                "compute_seconds_est": comp_est,
+                "collective_seconds_est": coll_est,
             }
 
     # words/sec vs roofline: measured items/s over the bound the compiled

@@ -443,14 +443,70 @@ def check_regression(
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
-        return 1, (
+        rc, msg = 1, (
             f"REGRESSION: newest value {newest:,.1f} is "
             f"{(1 - newest / baseline) * 100:.1f}% below baseline "
             f"{baseline:,.1f} (allowed {max_drop_pct:.1f}%)"
         )
+    else:
+        rc, msg = 0, (
+            f"ok: newest value {newest:,.1f} vs baseline {baseline:,.1f} "
+            f"({(newest / baseline - 1) * 100:+.1f}%, floor {floor:,.1f})"
+        )
+    s_rc, s_msg = _check_scaling_regression(measured, max_drop_pct)
+    if s_msg:
+        msg = f"{msg}\n{s_msg}"
+    return max(rc, s_rc), msg
+
+
+def _scaling_value(record: Dict) -> Optional[float]:
+    """Gateable number from a bench payload's ``scaling`` block (aggregate
+    f32 words/sec across the mesh), or None when the lane didn't run."""
+    scal = record.get("payload", {}).get("scaling")
+    if not isinstance(scal, dict):
+        return None
+    v = scal.get("aggregate_words_per_sec")
+    return float(v) if isinstance(v, (int, float)) and v > 0 else None
+
+
+def _check_scaling_regression(
+    measured: List[Dict], max_drop_pct: float
+) -> Tuple[int, Optional[str]]:
+    """Gate the scale-out lane's aggregate words/sec alongside the headline.
+
+    Only measured records that carried a populated ``scaling`` block count;
+    a ledger without any (pre-lane history) or with a single one gates
+    nothing — the lane must not be able to fail CI before it has a
+    comparable history.
+    """
+    with_scaling = [
+        (r, _scaling_value(r)) for r in measured if _scaling_value(r)
+    ]
+    if not with_scaling:
+        return 0, None
+    newest_rec, newest = with_scaling[-1]
+    if measured and measured[-1] is not newest_rec:
+        return 0, (
+            "scaling: newest measured record has no scaling block "
+            f"(last seen {newest:,.1f} aggregate words/s)"
+        )
+    earlier = [v for _, v in with_scaling[:-1]]
+    if not earlier:
+        return 0, (
+            f"scaling: single measured record (aggregate {newest:,.1f} "
+            "words/s); nothing to compare against"
+        )
+    baseline = max(earlier)
+    floor = baseline * (1.0 - max_drop_pct / 100.0)
+    if newest < floor:
+        return 1, (
+            f"scaling REGRESSION: aggregate {newest:,.1f} words/s is "
+            f"{(1 - newest / baseline) * 100:.1f}% below baseline "
+            f"{baseline:,.1f} (allowed {max_drop_pct:.1f}%)"
+        )
     return 0, (
-        f"ok: newest value {newest:,.1f} vs baseline {baseline:,.1f} "
-        f"({(newest / baseline - 1) * 100:+.1f}%, floor {floor:,.1f})"
+        f"scaling ok: aggregate {newest:,.1f} vs baseline {baseline:,.1f} "
+        f"words/s ({(newest / baseline - 1) * 100:+.1f}%)"
     )
 
 
