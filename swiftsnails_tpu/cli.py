@@ -15,7 +15,15 @@ Usage::
     python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
     python -m swiftsnails_tpu ledger-report --check-regression 10   # bench gate
+    python -m swiftsnails_tpu ledger-report --failures   # outage/chaos timeline
     python -m swiftsnails_tpu worker -config ...   # alias of train (parity)
+
+Resilience (docs/RESILIENCE.md): ``resume: auto`` continues an interrupted
+run from the newest verified checkpoint (tables + data cursor); a real
+SIGTERM drains with a final save and a ledger ``outage`` record instead of
+dying mid-step; ``guardrail: 1`` arms the NaN/rollback step guardrail; the
+fault-injection drills live in ``tools/chaos_drill.py`` and
+``bench.py --lane chaos``.
 
 ``master`` / ``server`` are accepted for parity and explain the collapse.
 """
@@ -59,6 +67,12 @@ def cmd_train(argv: List[str]) -> int:
     metrics = MetricsLogger(path=cfg.get_str("metrics_path", "") or None, echo=True)
     loop = TrainLoop(trainer, metrics=metrics, log_every=cfg.get_int("log_every", 100))
     state = loop.run(seed=cfg.get_int("seed", 0))
+    if loop.preempted:
+        print(
+            "preempted (SIGTERM): drained with a final checkpoint; "
+            "restart with `resume: auto` to continue this run",
+            file=sys.stderr,
+        )
     barrier("end_of_training")  # MasterTerminate parity
     out = cfg.get_str("output", "")
     if out:

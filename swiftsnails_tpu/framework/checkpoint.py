@@ -1,4 +1,4 @@
-"""Checkpoint / resume / text export.
+"""Checkpoint / resume / text export — now *verified* checkpoints.
 
 The reference's checkpointing is write-only (survey §5): periodic text dumps
 of every shard to ``param_backup_root/param-<n>.txt`` every
@@ -6,25 +6,42 @@ of every shard to ``param_backup_root/param-<n>.txt`` every
 plus a final dump to stdout on terminate (``server/terminate.h:32-45``,
 ``sparsetable.h:100-104``). **No load path exists.**
 
-This module provides all three, properly:
+This module provides the full recovery story:
 
 * :func:`save_checkpoint` — sharded binary checkpoint via orbax (each host
-  writes its shards; works 1-chip to multi-pod);
+  writes its shards; works 1-chip to multi-pod). Every completed save is
+  **committed by a manifest** (``manifest.json``, atomic tmp+rename write)
+  carrying the step, config hash, per-array CRC32C, and the data-stream
+  cursor — a step dir without a committed manifest is, by definition, a torn
+  save. ``wait=False`` saves run in the background; their manifests commit
+  at the next save (orbax serializes saves) or at
+  :func:`wait_for_checkpoints`, which also **returns the write errors** so
+  TrainLoop can surface them as ledger events instead of losing them.
 * :func:`restore_checkpoint` — resume (absent in the reference, required for
-  a real framework); restores onto the template's shardings;
+  a real framework); restores onto the template's shardings and *verifies*
+  the manifest's checksums against the restored bytes
+  (:class:`CheckpointError` on mismatch — silent corruption never trains).
+* :func:`prune_checkpoints` — ``param_backup_keep`` retention: old ``step_*``
+  dirs are removed after a verified save, never the protected (restored-from)
+  step and never the newest intact one.
 * :func:`export_table_text` — ``key<TAB>value`` text dump for artifact parity
   with the reference's output format (``SparseTableShard::operator<<``,
   ``sparsetable.h:49-56``).
 
 Config keys honored: ``param_backup_period``, ``param_backup_root`` (survey
-§2.9), plus ``resume`` for the new restore path.
+§2.9), plus ``resume`` (``1`` / ``auto``) and ``param_backup_keep`` for the
+recovery path (see ``resilience/resume.py`` and ``docs/RESILIENCE.md``).
 """
 
 from __future__ import annotations
 
+import json
 import os
 import re
-from typing import Any, Optional
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -32,12 +49,25 @@ import jax
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
 
+MANIFEST_NAME = "manifest.json"
+MANIFEST_SCHEMA = 1
+
+
+class CheckpointError(Exception):
+    """A checkpoint failed verification (manifest mismatch / corrupt bytes)."""
+
 
 def _step_dir(root: str, step: int) -> str:
     return os.path.join(os.path.abspath(root), f"step_{step}")
 
 
 _async_ckptr = None
+# manifests of in-flight (wait=False) saves, committed once orbax finishes;
+# guarded by _pending_lock. Write errors accumulate in _ckpt_errors until a
+# caller collects them via wait_for_checkpoints().
+_pending: List[Dict] = []
+_ckpt_errors: List[str] = []
+_pending_lock = threading.RLock()
 
 
 def _checkpointer():
@@ -49,49 +79,325 @@ def _checkpointer():
     return _async_ckptr
 
 
-def save_checkpoint(root: str, state: Any, step: int, wait: bool = True) -> str:
-    """Write a sharded checkpoint for ``step`` under ``root`` (param_backup parity).
+# ------------------------------------------------------------- manifest ---
+
+
+def _crc32c(data: bytes) -> Tuple[int, str]:
+    """CRC of ``data``: CRC32C (Castagnoli) when google_crc32c is available,
+    zlib CRC32 otherwise — the algorithm used is recorded in the manifest so
+    verification always replays the right one."""
+    try:
+        import google_crc32c
+
+        return int(google_crc32c.value(data)), "crc32c"
+    except ImportError:
+        import zlib
+
+        return int(zlib.crc32(data)), "crc32"
+
+
+def _array_leaves(state: Any) -> List[Tuple[str, Any]]:
+    """(keypath-string, leaf) for every array-like leaf of ``state``."""
+    out = []
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    for path, leaf in leaves:
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            out.append((jax.tree_util.keystr(path), leaf))
+    return out
+
+
+def build_manifest(
+    state: Any,
+    step: int,
+    cursor: Optional[Dict] = None,
+    config_hash: Optional[str] = None,
+) -> Dict:
+    """Checksum manifest of ``state``: per-array CRC + shape/dtype, the
+    data-stream cursor, and the config hash. Forces a host transfer of every
+    array (the same bytes orbax will write)."""
+    arrays = {}
+    for key, leaf in _array_leaves(state):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        crc, algo = _crc32c(a.tobytes())
+        arrays[key] = {
+            "crc": crc,
+            "algo": algo,
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+        }
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "step": int(step),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "config_hash": config_hash,
+        "data_cursor": dict(cursor) if cursor else {"step": int(step)},
+        "arrays": arrays,
+    }
+
+
+def read_manifest(root: str, step: int) -> Optional[Dict]:
+    """The committed manifest for ``step``, or None (torn/legacy save)."""
+    path = os.path.join(_step_dir(root, step), MANIFEST_NAME)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def verify_state(state: Any, manifest: Dict) -> List[str]:
+    """Problems found comparing ``state``'s bytes against ``manifest``
+    (empty list = intact). Used after restore: a flipped bit anywhere in the
+    on-disk arrays surfaces here even when the storage layer read it back
+    without complaint."""
+    problems: List[str] = []
+    recorded = manifest.get("arrays")
+    if not isinstance(recorded, dict) or not recorded:
+        return ["manifest has no array records"]
+    seen = set()
+    for key, leaf in _array_leaves(state):
+        meta = recorded.get(key)
+        seen.add(key)
+        if meta is None:
+            problems.append(f"{key}: not in manifest")
+            continue
+        a = np.ascontiguousarray(np.asarray(leaf))
+        if list(a.shape) != list(meta.get("shape", [])):
+            problems.append(
+                f"{key}: shape {list(a.shape)} != manifest {meta.get('shape')}"
+            )
+            continue
+        crc, algo = _crc32c(a.tobytes())
+        if algo != meta.get("algo"):
+            # manifest written with a different CRC flavor than this host
+            # computes — replay the recorded one via zlib when possible
+            if meta.get("algo") == "crc32":
+                import zlib
+
+                crc = int(zlib.crc32(a.tobytes()))
+            else:
+                problems.append(
+                    f"{key}: crc algorithm {meta.get('algo')!r} unavailable"
+                )
+                continue
+        if int(crc) != int(meta.get("crc", -1)):
+            problems.append(f"{key}: crc mismatch (corrupt bytes)")
+    missing = set(recorded) - seen
+    for key in sorted(missing):
+        problems.append(f"{key}: in manifest but absent from restored state")
+    return problems
+
+
+# ------------------------------------------------------------------ save ---
+
+
+def _note_error(msg: str, ledger=None) -> None:
+    with _pending_lock:
+        _ckpt_errors.append(msg)
+    if ledger is not None:
+        try:
+            ledger.append(
+                "cache_error", {"source": "checkpoint", "error": msg}
+            )
+        except Exception:
+            pass
+
+
+def _commit_entry(entry: Dict) -> None:
+    """Write the manifest (atomic) into the now-durable step dir and apply
+    retention. Any failure is recorded, never raised — a manifest commit
+    error must not take down the training loop."""
+    from swiftsnails_tpu.telemetry.ledger import atomic_write_json
+
+    path = entry["path"]
+    try:
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"checkpoint dir missing after save: {path}")
+        atomic_write_json(os.path.join(path, MANIFEST_NAME), entry["manifest"])
+    except Exception as e:
+        _note_error(f"manifest commit failed for {path}: {e}", entry.get("ledger"))
+        return
+    ledger = entry.get("ledger")
+    if ledger is not None:
+        try:
+            ledger.append(
+                "checkpoint",
+                {
+                    "root": os.path.abspath(entry["root"]),
+                    "step": entry["manifest"]["step"],
+                    "config_hash": entry["manifest"].get("config_hash"),
+                    "data_cursor": entry["manifest"].get("data_cursor"),
+                },
+            )
+        except Exception:
+            pass  # record-keeping never blocks the save path
+    keep = entry.get("keep") or 0
+    if keep > 0:
+        try:
+            prune_checkpoints(
+                entry["root"], keep, protect=entry.get("protect"),
+                ledger=ledger,
+            )
+        except Exception as e:
+            _note_error(f"retention prune failed under {entry['root']}: {e}",
+                        ledger)
+
+
+def _drain_pending_locked() -> None:
+    while _pending:
+        _commit_entry(_pending.pop(0))
+
+
+def save_checkpoint(
+    root: str,
+    state: Any,
+    step: int,
+    wait: bool = True,
+    cursor: Optional[Dict] = None,
+    config_hash: Optional[str] = None,
+    keep: int = 0,
+    protect: Optional[int] = None,
+    ledger=None,
+) -> str:
+    """Write a sharded checkpoint for ``step`` under ``root`` (param_backup
+    parity), committed by a checksum manifest.
 
     ``wait=False`` returns once device buffers are snapshotted and lets the
     write proceed in the background (the periodic-save path in TrainLoop);
-    the next save or :func:`wait_for_checkpoints` joins it. The reference
+    the manifest commits when the write completes — at the next save (orbax
+    serializes them) or at :func:`wait_for_checkpoints`. The reference
     blocked its push handlers while dumping shards to text
     (``server/init.h:128-149``) — async here means training never stalls.
+
+    ``cursor`` is the data-stream position (at least ``{"step": N}``) stored
+    in the manifest so ``resume: auto`` can continue the stream instead of
+    restarting it. ``keep > 0`` applies ``param_backup_keep`` retention after
+    the manifest commit; ``protect`` is a step that must never be pruned
+    (the step this run restored from).
     """
     path = _step_dir(root, step)
+    manifest = build_manifest(state, step, cursor=cursor, config_hash=config_hash)
     ckptr = _checkpointer()
-    ckptr.save(path, state, force=True)
+    try:
+        # orbax's save first joins any in-flight background save, so by the
+        # time it returns every previously-pending manifest is committable
+        ckptr.save(path, state, force=True)
+    except Exception as e:
+        _note_error(f"checkpoint save failed for {path}: {e}", ledger)
+        raise
+    with _pending_lock:
+        _drain_pending_locked()
+        _pending.append(
+            {
+                "root": root,
+                "path": path,
+                "manifest": manifest,
+                "keep": keep,
+                "protect": protect,
+                "ledger": ledger,
+            }
+        )
     if wait:
-        ckptr.wait_until_finished()
+        wait_for_checkpoints()
     return path
 
 
-def wait_for_checkpoints() -> None:
-    """Join any in-flight async checkpoint writes."""
+def wait_for_checkpoints() -> List[str]:
+    """Join any in-flight async checkpoint writes, commit their manifests,
+    and return (clearing) the accumulated write-error descriptions — the
+    TrainLoop surfaces these as ledger events in its ``finally``."""
     if _async_ckptr is not None:
-        _async_ckptr.wait_until_finished()
+        try:
+            _async_ckptr.wait_until_finished()
+        except Exception as e:
+            _note_error(f"async checkpoint write failed: {e}")
+    with _pending_lock:
+        _drain_pending_locked()
+        errors = list(_ckpt_errors)
+        _ckpt_errors.clear()
+    return errors
 
 
-def latest_step(root: str) -> Optional[int]:
-    """Newest completed checkpoint step under ``root``, or None."""
+# ------------------------------------------------------------- discovery ---
+
+
+def all_steps(root: str) -> List[int]:
+    """Every ``step_*`` dir under ``root``, ascending (committed or torn)."""
     if not os.path.isdir(root):
-        return None
+        return []
     steps = []
     for name in os.listdir(root):
         m = _STEP_RE.match(name)
         if m:
             steps.append(int(m.group(1)))
-    return max(steps) if steps else None
+    return sorted(steps)
 
 
-def restore_checkpoint(root: str, state_template: Any, step: Optional[int] = None) -> Any:
+def latest_step(root: str) -> Optional[int]:
+    """Newest checkpoint step under ``root``, or None."""
+    steps = all_steps(root)
+    return steps[-1] if steps else None
+
+
+def intact_steps(root: str) -> List[int]:
+    """Steps with a committed (parseable) manifest, newest first. Steps
+    without one are either legacy saves or torn writes — restore still
+    accepts legacy dirs, but they never count as *verified*."""
+    return [s for s in reversed(all_steps(root)) if read_manifest(root, s)]
+
+
+# -------------------------------------------------------------- retention ---
+
+
+def prune_checkpoints(
+    root: str, keep: int, protect: Optional[int] = None, ledger=None
+) -> List[int]:
+    """``param_backup_keep`` retention: keep the newest ``keep`` *intact*
+    steps (plus the newest step of any kind, plus ``protect`` — the step a
+    resumed run restored from is never deleted under it). Returns the pruned
+    steps."""
+    if keep <= 0:
+        return []
+    steps = all_steps(root)
+    if not steps:
+        return []
+    intact = intact_steps(root)
+    protected = set(intact[:keep])
+    protected.add(steps[-1])  # the newest dir may still be committing
+    if protect is not None:
+        protected.add(int(protect))
+    pruned = []
+    for s in steps:
+        if s in protected:
+            continue
+        try:
+            shutil.rmtree(_step_dir(root, s))
+            pruned.append(s)
+        except OSError as e:
+            _note_error(f"prune of step_{s} under {root} failed: {e}", ledger)
+    return pruned
+
+
+# --------------------------------------------------------------- restore ---
+
+
+def restore_checkpoint(
+    root: str,
+    state_template: Any,
+    step: Optional[int] = None,
+    verify: bool = True,
+) -> Any:
     """Restore state (resume path — the capability the reference lacks).
 
     ``state_template`` supplies structure, dtypes, and shardings (pass a
-    freshly-initialized state); ``step`` defaults to the latest.
+    freshly-initialized state); ``step`` defaults to the latest. With
+    ``verify`` (default) the restored bytes are checked against the step's
+    committed manifest — a mismatch raises :class:`CheckpointError` instead
+    of silently training on corrupt tables. Legacy dirs without a manifest
+    restore unverified. Callers that must *survive* corruption walk back via
+    :func:`swiftsnails_tpu.resilience.resume.resume_state`.
     """
-    import orbax.checkpoint as ocp
-
     wait_for_checkpoints()  # never read past an in-flight async save
     if step is None:
         step = latest_step(root)
@@ -103,7 +409,17 @@ def restore_checkpoint(root: str, state_template: Any, step: Optional[int] = Non
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
         state_template,
     )
-    return ckptr.restore(path, abstract)
+    restored = ckptr.restore(path, abstract)
+    if verify:
+        manifest = read_manifest(root, step)
+        if manifest is not None:
+            problems = verify_state(restored, manifest)
+            if problems:
+                raise CheckpointError(
+                    f"{path}: manifest verification failed: "
+                    + "; ".join(problems[:4])
+                )
+    return restored
 
 
 def export_table_text(table: jax.Array, path_or_file, keys: Optional[np.ndarray] = None,

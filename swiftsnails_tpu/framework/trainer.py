@@ -16,12 +16,17 @@ Capability parity with the reference's worker framework
 
 Config keys honored (reference inventory, survey §2.9): ``num_iters``,
 ``learning_rate``, ``batch_size``, ``param_backup_period``,
-``param_backup_root``, ``local_train``.
+``param_backup_root``, ``local_train`` — plus the resilience surface
+(``docs/RESILIENCE.md``): ``param_backup_keep``, ``resume`` (``1``/``auto``),
+``guardrail`` / ``guard_max_update_norm`` / ``guard_max_consecutive``, and
+``chaos_spec`` / ``chaos_seed``.
 """
 
 from __future__ import annotations
 
 import queue
+import signal
+import sys
 import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
@@ -96,6 +101,7 @@ class _Prefetcher:
         self._q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
+        self._exhausted = False
 
         def produce():
             try:
@@ -130,8 +136,16 @@ class _Prefetcher:
         return self
 
     def __next__(self):
+        if self._exhausted:
+            # idempotent end state: a retrying consumer (resilience path)
+            # must re-see the error/stop instead of blocking on the drained
+            # queue forever
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
         item = self._q.get()
         if item is self._DONE:
+            self._exhausted = True
             self._thread.join()
             if self._err is not None:
                 raise self._err
@@ -154,6 +168,42 @@ class _Prefetcher:
         self._thread.join(timeout=2.0)
 
 
+_STREAM_END = object()
+
+
+class _RetryingStream:
+    """Iterator adapter that survives transient ``OSError`` from the batch
+    stream (a flaky filesystem read, or a chaos-injected
+    :class:`~swiftsnails_tpu.resilience.chaos.TransientDataError`): each
+    failed fetch is retried up to ``retries`` times before the error
+    propagates. Only wrapped in when resilience is active — the plain hot
+    path keeps the raw iterator."""
+
+    def __init__(self, inner, retries: int = 3, on_error=None):
+        self._inner = inner
+        self.retries = retries
+        self._on_error = on_error
+        self.retried = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        for attempt in range(self.retries + 1):
+            try:
+                return next(self._inner)
+            except StopIteration:
+                raise
+            except OSError as e:
+                recovered = attempt < self.retries
+                self.retried += 1
+                if self._on_error is not None:
+                    self._on_error(e, attempt, recovered)
+                if not recovered:
+                    raise
+        raise AssertionError("unreachable")
+
+
 class TrainLoop:
     """The driver: jit with state donation, device feed, metrics, checkpoints."""
 
@@ -170,33 +220,74 @@ class TrainLoop:
         cfg = trainer.config
         self.backup_period = cfg.get_int("param_backup_period", 0)
         self.backup_root = cfg.get_str("param_backup_root", "")
+        self.backup_keep = cfg.get_int("param_backup_keep", 3)
+        from swiftsnails_tpu.telemetry.ledger import config_hash
+
+        self.config_hash = config_hash(cfg.as_dict())
+        # the ledger rides with any ledger_path (resilience events need it
+        # even when the full telemetry stack is off); tracer/registry/black
+        # box stay telemetry-gated below
+        ledger_path = cfg.get_str("ledger_path", "")
+        if ledger_path:
+            from swiftsnails_tpu.telemetry import Ledger
+
+            self.ledger = Ledger(ledger_path)
+        else:
+            self.ledger = None
+        self._restored_step = None  # set by resume; protected from pruning
+        self._items_seen = 0
         if checkpoint_fn is None and self.backup_root:
             from swiftsnails_tpu.framework.checkpoint import save_checkpoint
 
-            # async periodic saves: training continues while shards write
-            checkpoint_fn = lambda state, step: save_checkpoint(
-                self.backup_root, state, step, wait=False
-            )
+            # async periodic saves: training continues while shards write;
+            # the manifest (step, config hash, CRCs, data cursor) commits
+            # when the write lands, and retention prunes old generations
+            def checkpoint_fn(state, step):
+                save_checkpoint(
+                    self.backup_root, state, step, wait=False,
+                    cursor={"step": step, "items": self._items_seen},
+                    config_hash=self.config_hash,
+                    keep=self.backup_keep, protect=self._restored_step,
+                    ledger=self.ledger,
+                )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
+        # resilience is opt-in per key: `guardrail: 1` arms the per-step
+        # health check + rollback; a non-empty `chaos_spec` arms the fault
+        # injector. Off => both stay None and the hot path pays flag checks.
+        if cfg.get_bool("guardrail", False):
+            from swiftsnails_tpu.resilience.guardrail import StepGuardrail
+
+            self.guardrail = StepGuardrail(
+                max_update_norm=cfg.get_float("guard_max_update_norm", 0.0),
+                max_consecutive=cfg.get_int("guard_max_consecutive", 3),
+            )
+        else:
+            self.guardrail = None
+        if cfg.get_str("chaos_spec", "").strip():
+            from swiftsnails_tpu.resilience.chaos import ChaosPlan
+
+            self.chaos = ChaosPlan.from_config(cfg, ledger=self.ledger)
+        else:
+            self.chaos = None
+        self._preempt = threading.Event()
+        self._preempt_reason = None
+        self.preempted = False
+        self._prev_sigterm = None
         # telemetry is opt-in (`telemetry: 1` or a `trace_path`); when off,
-        # tracer/registry/black-box/ledger stay None and run() takes the
+        # tracer/registry/black-box stay None and run() takes the
         # uninstrumented branch
         self.trace_path = cfg.get_str("trace_path", "")
         if cfg.get_bool("telemetry", False) or self.trace_path:
             from swiftsnails_tpu.telemetry import (
-                BlackBox, Ledger, MetricRegistry, StdoutSummarySink, Tracer,
+                BlackBox, MetricRegistry, StdoutSummarySink, Tracer,
             )
-            from swiftsnails_tpu.telemetry.ledger import config_hash
 
             self.tracer = Tracer(path=self.trace_path or None)
             sinks = [self.metrics]
             if cfg.get_bool("telemetry_stdout", False):
                 sinks.append(StdoutSummarySink())
             self.registry = MetricRegistry(sinks=sinks)
-            ledger_path = cfg.get_str("ledger_path", "")
-            self.ledger = Ledger(ledger_path) if ledger_path else None
-            self.config_hash = config_hash(cfg.as_dict())
             bb_steps = cfg.get_int("blackbox_steps", 32)
             if bb_steps > 0:
                 self.blackbox = BlackBox(
@@ -215,8 +306,6 @@ class TrainLoop:
             self.tracer = None
             self.registry = None
             self.blackbox = None
-            self.ledger = None
-            self.config_hash = None
             self._want_audit = False
         self._audit_report = None
         # per-step dispatch cost trimming: the batch/replicated shardings are
@@ -237,6 +326,14 @@ class TrainLoop:
             return trainer.train_step(state, batch, rng)
 
         self._step_fn = jax.jit(_step, donate_argnums=(0,))
+        # guardrail rollback needs the pre-step tables to survive the step:
+        # instead of a per-step device copy, the guarded path runs a
+        # NON-donating compile of the same step — the input buffers ARE the
+        # snapshot (same 2x table memory as copy+donate, none of the copy
+        # bandwidth or dispatch)
+        self._step_fn_guarded = (
+            jax.jit(_step) if self.guardrail is not None else None
+        )
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         if self._batch_sharding is None:
@@ -252,15 +349,28 @@ class TrainLoop:
         trainer = self.trainer
         state = trainer.init_state()
         step = 0
-        if trainer.config.get_bool("resume", False) and self.backup_root:
-            from swiftsnails_tpu.framework.checkpoint import latest_step, restore_checkpoint
+        skip_batches = 0
+        from swiftsnails_tpu.resilience.resume import resume_mode
 
-            restored_step = latest_step(self.backup_root)
-            if restored_step is not None:
-                state = restore_checkpoint(self.backup_root, state, step=restored_step)
+        mode = resume_mode(trainer.config)
+        if mode != "off" and self.backup_root:
+            from swiftsnails_tpu.resilience.resume import resume_state
+
+            restored = resume_state(
+                self.backup_root, state, mode=mode, ledger=self.ledger,
+                config_hash=self.config_hash,
+            )
+            if restored is not None:
                 # continue the step counter so later checkpoints advance
                 # monotonically and the per-step RNG stream doesn't replay
-                step = restored_step
+                state, step, cursor = restored
+                self._restored_step = step
+                if mode == "auto":
+                    # continue the data stream where the checkpoint left it:
+                    # the batch generators are seed-deterministic, so
+                    # skipping the consumed prefix IS the saved cursor
+                    skip_batches = int(cursor.get("step", step) or 0)
+                    self._items_seen = int(cursor.get("items", 0) or 0)
         root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
         total_items = 0
@@ -269,24 +379,42 @@ class TrainLoop:
         tel = self.tracer
         reg = self.registry
         bb = self.blackbox
-        if bb is not None:
-            bb.install_signal_handler(tracer=tel)
+        guard = self.guardrail
+        chaos = self.chaos
+        resilient = guard is not None or chaos is not None
+        self._install_sigterm()
         it = iter(batches)
+        if chaos is not None:
+            it = chaos.wrap_stream(it)
+        if resilient:
+            it = _RetryingStream(it, on_error=self._on_stream_error)
+        if skip_batches:
+            for _ in range(skip_batches):
+                if next(it, _STREAM_END) is _STREAM_END:
+                    break
+        preempted = self._preempt.is_set
         try:
-            # hot-path contract: with telemetry off (tel is None) each step
-            # pays exactly the one flag check below — the instrumented body
-            # never runs and allocates nothing
+            # hot-path contract: with telemetry and resilience off each step
+            # pays exactly the flag checks below — the instrumented bodies
+            # never run and allocate nothing
             if tel is None:
                 for batch in it:
+                    if preempted():
+                        break
                     n_items = trainer.items_per_batch(batch)
                     self.profiler.on_step(step)
                     with step_annotation(trainer.name, step):
                         dev_batch = self._device_batch(batch)
                         # fold_in happens inside the jitted step; the numpy
                         # scalar is an array operand (no per-value retrace)
-                        state, last_metrics = self._step_fn(
-                            state, dev_batch, root_rng, np.uint32(step))
+                        if resilient:
+                            state, last_metrics = self._resilient_step(
+                                state, dev_batch, root_rng, step)
+                        else:
+                            state, last_metrics = self._step_fn(
+                                state, dev_batch, root_rng, np.uint32(step))
                     step += 1
+                    self._items_seen += n_items
                     self.metrics.count(n_items)
                     if self.log_every and step % self.log_every == 0:
                         host = {k: float(v) for k, v in last_metrics.items()}
@@ -297,6 +425,8 @@ class TrainLoop:
                         break
             else:
                 while True:
+                    if preempted():
+                        break
                     t_step0 = time.monotonic()
                     with tel.span("prefetch-wait"):
                         try:
@@ -322,10 +452,15 @@ class TrainLoop:
                             self._audit_report = self._audit_step_fn(
                                 state, dev_batch, root_rng, np.uint32(step))
                         with tel.span("step", step=step):
-                            state, last_metrics = self._step_fn(
-                                state, dev_batch, root_rng, np.uint32(step))
+                            if resilient:
+                                state, last_metrics = self._resilient_step(
+                                    state, dev_batch, root_rng, step)
+                            else:
+                                state, last_metrics = self._step_fn(
+                                    state, dev_batch, root_rng, np.uint32(step))
                     step += 1
                     total_items += n_items
+                    self._items_seen += n_items
                     reg.counter("steps").inc()
                     reg.counter("items").inc(n_items)
                     step_ms = (time.monotonic() - t_step0) * 1e3
@@ -360,10 +495,32 @@ class TrainLoop:
                 batches.close()
             if tel is not None:
                 tel.close()
-            if bb is not None:
-                bb.uninstall_signal_handler()
+            self._uninstall_sigterm()
+            # join outstanding background checkpoint writes HERE, not only on
+            # the happy path: an async save must never be orphaned by an
+            # exception, and its write errors become ledger events, not lost
+            if self.checkpoint_fn is not None:
+                self._join_checkpoints()
         # block so throughput/final metrics are real, then final flush
         jax.block_until_ready(jax.tree_util.tree_leaves(state))
+        if self._preempt.is_set():
+            # preemption drain: final save + durable outage record, THEN exit
+            # — the next run's `resume: auto` continues from this state
+            self.preempted = True
+            if self.checkpoint_fn is not None:
+                try:
+                    self.checkpoint_fn(state, step)
+                except Exception as e:
+                    self._ledger_event("cache_error", {
+                        "source": "checkpoint",
+                        "error": f"preemption final save failed: {e}",
+                    })
+            self._ledger_event("outage", {
+                "probe": "preemption",
+                "reason": self._preempt_reason or "SIGTERM",
+                "step": step,
+                "error": "run preempted; drained with a final checkpoint",
+            })
         host = {}
         if step % max(self.log_every, 1) != 0 or not self.log_every:
             host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
@@ -379,10 +536,123 @@ class TrainLoop:
         if tel is not None:
             self._finalize_run_record(step, total_items, host)
         if self.checkpoint_fn is not None:
-            from swiftsnails_tpu.framework.checkpoint import wait_for_checkpoints
-
-            wait_for_checkpoints()
+            self._join_checkpoints()  # joins the preemption final save too
         return state
+
+    # -- resilience (guardrail / chaos / preemption) ------------------------
+
+    def _resilient_step(self, state, dev_batch, root_rng, step: int):
+        """One step under the guardrail and/or the chaos plan.
+
+        Order matters: the rollback snapshot is taken BEFORE any chaos
+        injection, so the guardrail's recovery target is always clean state —
+        a poisoned pulled row (pre-step fault) or a poisoned update
+        (post-step fault) is detected at commit and discarded whole.
+        """
+        guard = self.guardrail
+        chaos = self.chaos
+        # with the guardrail armed the step runs WITHOUT donation, so the
+        # incoming state is itself the rollback snapshot (chaos pre-step
+        # poison builds new arrays and never mutates it)
+        snap = state if guard is not None else None
+        if chaos is not None:
+            state = chaos.pre_step(state, step)
+        step_fn = self._step_fn_guarded if guard is not None else self._step_fn
+        new_state, metrics = step_fn(
+            state, dev_batch, root_rng, np.uint32(step))
+        if chaos is not None:
+            new_state, metrics = chaos.post_step(new_state, metrics, step)
+        if guard is not None:
+            new_state, metrics, tripped, exhausted = guard.commit(
+                snap, new_state, metrics)
+            if tripped:
+                if self.registry is not None:
+                    self.registry.counter("guard_trips").inc()
+                print(
+                    f"guardrail: step {step} rolled back "
+                    f"({guard.last_trip_reason}); trust={guard.trust:.3f}",
+                    file=sys.stderr,
+                )
+            if exhausted:
+                from swiftsnails_tpu.resilience.guardrail import GuardrailExhausted
+
+                if self.blackbox is not None:
+                    self.blackbox.dump("guardrail-giveup", tracer=self.tracer)
+                raise GuardrailExhausted(
+                    f"{guard.consecutive} consecutive unhealthy steps "
+                    f"(last: {guard.last_trip_reason}); giving up at step {step}"
+                )
+        if chaos is not None:
+            chaos.maybe_corrupt_checkpoint(self.backup_root, step)
+            reason = chaos.wants_preempt(step)
+            if reason is not None:
+                self.request_preemption(reason)
+        return new_state, metrics
+
+    def request_preemption(self, reason: str = "SIGTERM") -> None:
+        """Ask the loop to drain at the next step boundary: final save,
+        ledger ``outage`` record, then a normal return (``self.preempted``)."""
+        self._preempt_reason = reason
+        self._preempt.set()
+
+    def _install_sigterm(self) -> None:
+        """Graceful-preemption SIGTERM handler: black-box dump (the ring is
+        most valuable at the moment of death) + drain request. Replaces the
+        black box's own die-after-dump handler for the duration of the run;
+        main-thread only (signal module restriction)."""
+
+        def _on_term(signum, frame):
+            if self.blackbox is not None:
+                self.blackbox.dump("sigterm", tracer=self.tracer)
+            self.request_preemption("SIGTERM")
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:  # not the main thread: cooperative preempt only
+            self._prev_sigterm = None
+
+    def _uninstall_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+
+    def _ledger_event(self, kind: str, record: Dict) -> None:
+        """Best-effort ledger append — resilience bookkeeping never fails
+        the run."""
+        if self.ledger is None:
+            return
+        try:
+            self.ledger.append(kind, record)
+        except Exception as e:
+            print(f"resilience: ledger append failed: {e}", file=sys.stderr)
+
+    def _on_stream_error(self, exc, attempt: int, recovered: bool) -> None:
+        print(
+            f"data stream error (attempt {attempt + 1}): {exc}"
+            + ("; retrying" if recovered else "; giving up"),
+            file=sys.stderr,
+        )
+        if self.registry is not None:
+            self.registry.counter("stream_retries").inc()
+        if not recovered:
+            self._ledger_event("outage", {
+                "probe": "data_stream",
+                "error": f"{type(exc).__name__}: {exc}",
+            })
+
+    def _join_checkpoints(self) -> None:
+        """Join background checkpoint writes; surface write errors as ledger
+        events (they used to vanish inside the async checkpointer)."""
+        from swiftsnails_tpu.framework.checkpoint import wait_for_checkpoints
+
+        for err in wait_for_checkpoints():
+            print(f"checkpoint: {err}", file=sys.stderr)
+            self._ledger_event("cache_error", {
+                "source": "checkpoint", "error": err,
+            })
 
     # -- goodput + ledger finalization (telemetry-only paths) --------------
 
@@ -423,17 +693,22 @@ class TrainLoop:
             )
             self.metrics.log({"goodput": report, "step": steps})
             if self.ledger is not None:
+                record = {
+                    "model": self.trainer.name,
+                    "config_hash": self.config_hash,
+                    "steps": steps,
+                    "items": items,
+                    "goodput": report,
+                    "final_metrics": final_metrics or None,
+                }
+                if self.guardrail is not None:
+                    record["guardrail"] = self.guardrail.summary()
+                if self.chaos is not None:
+                    record["chaos"] = self.chaos.summary()
+                if self.preempted:
+                    record["preempted"] = True
                 self.ledger.append(
-                    "run",
-                    {
-                        "model": self.trainer.name,
-                        "config_hash": self.config_hash,
-                        "steps": steps,
-                        "items": items,
-                        "goodput": report,
-                        "final_metrics": final_metrics or None,
-                    },
-                    env=env_fingerprint(include_devices=True),
+                    "run", record, env=env_fingerprint(include_devices=True),
                 )
         except Exception as e:  # observability must never fail the run
             import sys

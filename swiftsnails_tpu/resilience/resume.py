@@ -1,0 +1,109 @@
+"""Auto-resume: find the run's last *good* checkpoint and continue the run —
+tables **and** data-stream cursor — never crashing on a corrupt save.
+
+``resume: 1`` (legacy) restores the newest checkpoint that verifies, keeping
+the old semantics of restarting the data stream. ``resume: auto`` goes
+further: it consults the run ledger (``RUN_LEDGER.jsonl`` ``checkpoint``
+events, written at every verified save) for the run's last known-good step,
+verifies it against its manifest, walks back to the newest intact checkpoint
+when anything is corrupt (each rejection is a ``cache_error`` ledger event,
+never a crash), and returns the manifest's ``data_cursor`` so the TrainLoop
+can skip the already-consumed batches — a resumed loss curve is a
+*continuation* of the interrupted one, not a restart.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _ledger_known_steps(ledger, root: str, config_hash: Optional[str]) -> List[int]:
+    """Steps the ledger records as good saves under ``root`` (newest first).
+    A config-hash mismatch does not disqualify a record — resuming across a
+    benign config tweak is legal; shapes are enforced by the restore itself."""
+    if ledger is None:
+        return []
+    root = os.path.abspath(root)
+    try:
+        records = ledger.records("checkpoint")
+    except Exception:
+        return []
+    mine = [
+        rec for rec in records
+        if rec.get("root") == root and isinstance(rec.get("step"), int)
+    ]
+    # prefer records of this exact config, then the rest, each newest-first
+    same = [r["step"] for r in mine
+            if config_hash and r.get("config_hash") == config_hash]
+    rest = [r["step"] for r in mine if r["step"] not in same]
+    ordered = list(reversed(same)) + list(reversed(rest))
+    seen: set = set()
+    return [s for s in ordered if not (s in seen or seen.add(s))]
+
+
+def resume_state(
+    root: str,
+    template: Any,
+    mode: str = "latest",
+    ledger=None,
+    config_hash: Optional[str] = None,
+) -> Optional[Tuple[Any, int, Dict]]:
+    """Restore the newest intact checkpoint under ``root``.
+
+    Returns ``(state, step, data_cursor)`` or ``None`` when nothing under
+    ``root`` is restorable (a fresh run). Candidates are tried newest-first
+    — ledger-known-good steps first in ``auto`` mode — and every corrupt or
+    unrestorable candidate is recorded as a ``cache_error`` ledger event and
+    skipped, so a flipped bit in the newest save costs one backup period,
+    not the run.
+    """
+    from swiftsnails_tpu.framework.checkpoint import (
+        all_steps, intact_steps, read_manifest, restore_checkpoint, _step_dir,
+    )
+
+    disk = list(reversed(all_steps(root)))  # newest first, torn dirs included
+    if not disk:
+        return None
+    candidates: List[int] = []
+    if mode == "auto":
+        candidates.extend(
+            s for s in _ledger_known_steps(ledger, root, config_hash)
+            if s in set(disk)
+        )
+    candidates.extend(s for s in disk if s not in candidates)
+    # steps with a committed manifest outrank torn/legacy dirs of any age
+    intact = set(intact_steps(root))
+    candidates.sort(key=lambda s: (s in intact, s), reverse=True)
+
+    for step in candidates:
+        try:
+            state = restore_checkpoint(root, template, step=step, verify=True)
+        except Exception as e:
+            if ledger is not None:
+                try:
+                    ledger.append("cache_error", {
+                        "source": "checkpoint",
+                        "path": _step_dir(root, step),
+                        "error": f"{type(e).__name__}: {e}",
+                        "action": "walking back to an older checkpoint",
+                    })
+                except Exception:
+                    pass
+            continue
+        manifest = read_manifest(root, step) or {}
+        cursor = manifest.get("data_cursor") or {"step": step}
+        return state, step, cursor
+    return None
+
+
+def resume_mode(cfg) -> str:
+    """The ``resume`` config key, normalized: ``off`` / ``latest`` /
+    ``auto``. (``resume`` predates auto mode as a bool, so truthy words map
+    to ``latest``.)"""
+    raw = cfg.get_str("resume", "0").strip().lower()
+    if raw == "auto":
+        return "auto"
+    if raw in ("1", "true", "yes", "on"):
+        return "latest"
+    return "off"

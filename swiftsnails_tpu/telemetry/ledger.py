@@ -18,8 +18,13 @@ irrelevant; single-writer per path is assumed (the bench and trainer are).
 
 Record envelope::
 
-    {"schema": 1, "kind": "bench"|"run"|"outage"|"blackbox",
+    {"schema": 1, "kind": "bench"|"run"|"outage"|"blackbox"|"chaos"
+                          |"checkpoint"|"cache_error",
      "ts": "<UTC ISO8601>", "env": {...fingerprint...}, ...kind fields...}
+
+(``chaos`` = an injected drill fault, ``checkpoint`` = a verified save
+commit, ``cache_error`` = a corrupt bench cache OR checkpoint rejected /
+walked back — see ``ledger-report --failures`` for the timeline view.)
 
 ``python -m swiftsnails_tpu ledger-report`` (or ``tools/ledger_report.py``)
 renders the ledger; its ``--check-regression`` mode is the bench gate.
@@ -408,6 +413,81 @@ def render_report(ledger: Ledger) -> str:
     return "\n".join(lines)
 
 
+# failure-timeline view: every kind that marks something going wrong (or a
+# chaos drill making it go wrong on purpose), interleaved with run records
+# for context — `ledger-report --failures`
+FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error")
+
+
+def _failure_line(r: Dict) -> str:
+    kind = r.get("kind", "?")
+    ts = r.get("ts", "?")
+    if kind == "outage":
+        what = r.get("error") or r.get("reason") or ""
+        probe = r.get("probe")
+        extra = f" probe={probe}" if probe else ""
+        step = r.get("step")
+        extra += f" step={step}" if step is not None else ""
+        return f"  {ts}  OUTAGE   {extra.strip()}  {str(what)[:90]}"
+    if kind == "chaos":
+        return (
+            f"  {ts}  CHAOS    fault={r.get('fault')} step={r.get('step')}"
+            f" seed={r.get('seed')}"
+            + (f"  {r.get('detail')}" if r.get("detail") else "")
+        )
+    if kind == "blackbox":
+        return (
+            f"  {ts}  BLACKBOX reason={r.get('reason')} "
+            f"steps={r.get('first_step')}..{r.get('last_step')}  "
+            f"{r.get('dump_path')}"
+        )
+    if kind == "cache_error":
+        return (
+            f"  {ts}  CKPT/CACHE-ERROR source={r.get('source', 'bench-cache')}"
+            f"  {str(r.get('error', ''))[:90]}"
+        )
+    return f"  {ts}  {kind}"
+
+
+def render_failures(ledger: Ledger) -> str:
+    """Timeline of failure / chaos / black-box events next to run records —
+    the drill-audit view: what was injected, what broke, what recovered."""
+    records, bad = ledger.replay()
+    lines = [f"failure timeline: {ledger.path}"]
+    for warn in bad:
+        lines.append(f"  WARNING: {warn}")
+    shown = 0
+    for r in records:
+        kind = r.get("kind")
+        if kind in FAILURE_KINDS:
+            lines.append(_failure_line(r))
+            shown += 1
+        elif kind == "run":
+            g = r.get("guardrail") or {}
+            extra = ""
+            if g.get("trips_total"):
+                extra = (f"  guard: {g['trips_total']} trips, "
+                         f"{g['steps_skipped']} skipped")
+            if r.get("preempted"):
+                extra += "  [preempted]"
+            lines.append(
+                f"  {r.get('ts', '?')}  run      model={r.get('model')} "
+                f"steps={r.get('steps')}{extra}"
+            )
+        elif kind == "bench" and isinstance(r.get("payload"), dict) \
+                and isinstance(r["payload"].get("chaos"), dict):
+            c = r["payload"]["chaos"]
+            lines.append(
+                f"  {r.get('ts', '?')}  bench    chaos lane: "
+                f"recovered_all={c.get('recovered_all')} "
+                f"guard_overhead={c.get('guard_overhead_pct')}% "
+                f"loss_parity={c.get('loss_parity')}"
+            )
+    if shown == 0:
+        lines.append("  (no failure events recorded)")
+    return "\n".join(lines)
+
+
 def check_regression(
     ledger: Ledger,
     max_drop_pct: float,
@@ -431,7 +511,13 @@ def check_regression(
         and r["payload"]["value"] > 0
     ]
     if not measured:
-        return 2, "check-regression: no measured bench record in ledger"
+        msg = "check-regression: no measured bench record in ledger"
+        # chaos recovery is gated on correctness, not measured perf — a CPU
+        # chaos-lane record must still be able to fail (or pass) CI here
+        c_rc, c_msg = _check_chaos_regression(ledger)
+        if c_msg:
+            msg = f"{msg}\n{c_msg}"
+        return max(2, c_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -456,7 +542,10 @@ def check_regression(
     s_rc, s_msg = _check_scaling_regression(measured, max_drop_pct)
     if s_msg:
         msg = f"{msg}\n{s_msg}"
-    return max(rc, s_rc), msg
+    c_rc, c_msg = _check_chaos_regression(ledger)
+    if c_msg:
+        msg = f"{msg}\n{c_msg}"
+    return max(rc, s_rc, c_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -510,6 +599,36 @@ def _check_scaling_regression(
     )
 
 
+def _check_chaos_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the chaos lane's *recovery* alongside the perf headline: the
+    newest bench record carrying a ``chaos`` block (any platform — recovery
+    is correctness, so CPU lane runs count) must have recovered every drill
+    and held resume loss parity. No chaos history gates nothing."""
+    with_chaos = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("chaos"), dict)
+    ]
+    if not with_chaos:
+        return 0, None
+    c = with_chaos[-1]["payload"]["chaos"]
+    problems = []
+    if not c.get("recovered_all"):
+        bad = [k for k, v in (c.get("drills") or {}).items()
+               if not v.get("recovered")]
+        problems.append(
+            "unrecovered chaos drill(s): " + (", ".join(bad) or "unknown"))
+    parity = c.get("loss_parity")
+    if isinstance(parity, (int, float)) and parity > 0.05:
+        problems.append(f"resume loss parity {parity:.4f} > 0.05")
+    if problems:
+        return 1, "chaos REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"chaos ok: all drills recovered, guard overhead "
+        f"{c.get('guard_overhead_pct')}%, resume loss parity {parity}"
+    )
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -536,8 +655,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="JSON file whose 'value' field is the pinned baseline "
              "(e.g. a preserved BENCH_LAST_GOOD.json)",
     )
+    p.add_argument(
+        "--failures", action="store_true",
+        help="render the failure timeline (outage/chaos/blackbox/"
+             "cache_error events next to run records) instead of the "
+             "full report",
+    )
     args = p.parse_args(argv)
     ledger = Ledger(args.path)
+    if args.failures:
+        print(render_failures(ledger))
+        return 0
     if args.check_regression is not None:
         baseline = args.baseline
         if baseline is None and args.baseline_file:

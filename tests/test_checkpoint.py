@@ -205,6 +205,91 @@ def test_ctr_packed_mesh_state_roundtrip(tmp_path):
         np.asarray(resumed.table.table), np.asarray(state.table.table))
 
 
+def _restore_across(src_trainer, dst_trainer, tmp_path):
+    """Save from src's state layout, restore onto dst's template; verify
+    values, the template's shardings, and the manifest data cursor."""
+    from swiftsnails_tpu.framework.checkpoint import read_manifest
+
+    state = src_trainer.init_state()
+    root = str(tmp_path / "swap")
+    save_checkpoint(root, state, 5, cursor={"step": 5, "items": 1280})
+    restored = restore_checkpoint(root, dst_trainer.init_state(), step=5)
+    np.testing.assert_array_equal(
+        np.asarray(restored.in_table.table),
+        np.asarray(state.in_table.table),
+    )
+    # restored arrays land on the DESTINATION template's shardings
+    template = dst_trainer.init_state()
+    assert restored.in_table.table.sharding == template.in_table.table.sharding
+    man = read_manifest(root, 5)
+    assert man["data_cursor"] == {"step": 5, "items": 1280}
+    return restored
+
+
+def test_restore_single_device_onto_grouped_mesh(tmp_path):
+    """Resume must survive a topology change: a checkpoint saved without a
+    mesh restores onto the forced 8-device grouped mesh (CRC-verified), and
+    the data cursor rides along.
+
+    NOTE: dtype/layout must match for a cross-mesh restore — both sides use
+    the dense 2-D table layout here (the manifest records shape/dtype, so a
+    layout mismatch fails verification loudly, not silently)."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_word2vec import make_trainer
+
+    single = make_trainer()
+    meshed = make_trainer(mesh=make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4}))
+    restored = _restore_across(single, meshed, tmp_path)
+    assert restored.in_table.table.sharding.spec[0] == MODEL_AXIS
+
+
+def test_restore_grouped_mesh_onto_single_device(tmp_path):
+    """...and the reverse: an 8-device-mesh checkpoint restores onto a
+    single-device template (shrinking the topology), data cursor included."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_word2vec import make_trainer
+
+    meshed = make_trainer(mesh=make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4}))
+    single = make_trainer()
+    _restore_across(meshed, single, tmp_path)
+
+
+def test_restore_grouped_mesh_packed_across_meshes(tmp_path):
+    """The packed fused-grouped plane (the headline path's layout): a
+    1-device packed checkpoint restores onto the 8-device grouped mesh and
+    trains — the restore-onto-different-mesh contract for the production
+    config, cursor included."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_word2vec import make_trainer
+
+    import jax
+    import jax.numpy as jnp
+
+    common = dict(packed="1", fused="1", grouped="1", neg_mode="pool",
+                  pool_size="8", pool_block="64")
+    single = make_trainer(**common)
+    meshed = make_trainer(mesh=make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4}),
+                          **common)
+    state = single.init_state()
+    root = str(tmp_path / "packed-swap")
+    save_checkpoint(root, state, 3, cursor={"step": 3})
+    restored = restore_checkpoint(root, meshed.init_state(), step=3)
+    np.testing.assert_array_equal(
+        np.asarray(restored.out_table.table),
+        np.asarray(state.out_table.table))
+    # the restored state must actually step on the mesh plane
+    batch = next(iter(meshed.batches()))
+    dev = {k: jnp.asarray(v) for k, v in batch.items()}
+    _, metrics = jax.jit(meshed.train_step)(restored, dev, jax.random.PRNGKey(0))
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_async_save_then_restore(tmp_path):
     """wait=False saves must be joinable and restorable."""
     import jax.numpy as jnp

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Run the canned chaos drill matrix on CPU; exit nonzero on any
+unrecovered fault.
+
+The drills (``swiftsnails_tpu/resilience/drill.py``) inject every fault the
+resilience stack claims to survive — NaN/Inf gradient bursts, a poisoned
+parameter row, a transient data-stream I/O error, checkpoint bit rot, and a
+simulated preemption — and assert the run *recovers*: guardrail rollback
+with zero non-finite values reaching the master tables, retry instead of
+crash, manifest-verified walk-back, and a resumed run whose final loss
+matches an undisturbed one.
+
+    python tools/chaos_drill.py            # the full matrix
+    python tools/chaos_drill.py --fast     # the tier-1 subset
+    python tools/chaos_drill.py --json     # machine-readable results
+
+Every injection and every recovery event lands in the drill's own ledger
+(``<workdir>/<drill>/LEDGER.jsonl``); inspect one with
+``python -m swiftsnails_tpu ledger-report --failures <ledger>``.
+
+No accelerator required (or touched): the harness pins JAX_PLATFORMS=cpu
+unless the caller already pinned a platform.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="chaos_drill",
+        description="deterministic fault-injection drill matrix (CPU)",
+    )
+    p.add_argument("--fast", action="store_true",
+                   help="run the tier-1 fast subset only")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON object instead of the table")
+    p.add_argument("--workdir", default=None,
+                   help="keep drill artifacts (ledgers, checkpoints) here")
+    args = p.parse_args(argv)
+
+    from swiftsnails_tpu.resilience.drill import run_drill_matrix
+
+    results = run_drill_matrix(fast=args.fast, workdir=args.workdir)
+    failed = [k for k, v in results.items() if not v.get("recovered")]
+    if args.json:
+        print(json.dumps({"results": results, "failed": failed}))
+    else:
+        width = max(len(k) for k in results)
+        for name, res in results.items():
+            status = "RECOVERED" if res.get("recovered") else "UNRECOVERED"
+            detail = res.get("error") or ", ".join(
+                f"{k}={v}" for k, v in res.items()
+                if k not in ("recovered", "error") and not isinstance(v, dict)
+            )
+            print(f"{name:<{width}}  {status:<11}  {detail}")
+        print(
+            f"{len(results) - len(failed)}/{len(results)} drills recovered"
+            + (f"; FAILED: {', '.join(failed)}" if failed else "")
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

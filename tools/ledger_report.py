@@ -12,8 +12,14 @@ derived view of it. This tool renders the history — and gates CI:
 
     # bench gate: exit nonzero if the newest measured run is >10% below
     # the pinned baseline (default: best earlier measured ledger record;
-    # pin explicitly with --baseline VALUE or --baseline-file FILE)
+    # pin explicitly with --baseline VALUE or --baseline-file FILE).
+    # Also gates the scaling lane's aggregate words/sec and the chaos
+    # lane's recovery (unrecovered drill / resume-parity breach fails CI).
     python tools/ledger_report.py --check-regression 10
+
+    # failure timeline: outage / chaos-injection / black-box / checkpoint
+    # corruption events rendered next to run records
+    python tools/ledger_report.py --failures
 
 No accelerator required; jax is only imported if the ledger is missing
 version fields (never initialized).
