@@ -127,7 +127,8 @@ _state = {
     "at_scale": None,  # planted-pair structure at bench scale (dict)
     "scaling": None,  # multi-chip throughput lane (dict; see measure_scaling)
     "chaos": None,  # resilience lane (dict; see measure_chaos / --lane chaos)
-    "lane": "full",  # which lane emitted this line (full | chaos)
+    "serving": None,  # read-path latency lane (dict; see --lane serve)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -234,6 +235,7 @@ def _result_json(extra_error=None):
             "at_scale": _state["at_scale"],
             "scaling": _state["scaling"],
             "chaos": _state["chaos"],
+            "serving": _state["serving"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1090,6 +1092,59 @@ def run_chaos_lane() -> int:
     return 0 if block.get("recovered_all") else 1
 
 
+# -- serving (read-path) lane -------------------------------------------------
+#
+# `--lane serve` measures the query subsystem (`swiftsnails_tpu/serving/`):
+# two tiny verified checkpoints are loaded through Servant.from_checkpoint
+# and all three query kernels (pull, top-k, CTR score) run at two batch
+# buckets. Latency distribution + cache/shed behavior is correctness of the
+# serving machinery, so the lane is valid on CPU; the block lands in the
+# result JSON (`serving`), the run ledger, and the
+# `ledger-report --check-regression` gate (qps floor + p99 ceiling).
+
+
+def measure_serving() -> None:
+    """Populate ``_state['serving']`` with the read-path lane block."""
+    from swiftsnails_tpu.serving.bench_lane import serve_bench
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    block = serve_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["serving"] = block
+    print(
+        f"bench: serve lane: pull qps {block.get('qps')} "
+        f"p99 {block.get('p99_ms')}ms "
+        f"cache hit rate {block.get('cache_hit_rate')} "
+        f"shed {block.get('shed_count')}",
+        file=sys.stderr,
+    )
+
+
+def run_serve_lane() -> int:
+    """``--lane serve``: the read-path latency lane alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "serve"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_serving()
+    except Exception as e:
+        _state["errors"].append(
+            f"serve lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["serving"]
+    # the lane's headline is pull qps at the largest bucket: the lookup
+    # traffic a serving replica actually absorbs
+    _state["best"] = block.get("qps") or 0.0
+    _state["best_path"] = "serve-pull"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    return 0
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -1442,10 +1497,11 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="bench", description="word2vec words/sec/chip benchmark")
     parser.add_argument(
-        "--lane", choices=("full", "chaos"), default="full",
+        "--lane", choices=("full", "chaos", "serve"), default="full",
         help="full = the headline bench (default); chaos = the resilience "
              "lane alone (guardrail overhead + scripted-fault recovery "
-             "drills; valid on CPU)",
+             "drills; valid on CPU); serve = the read-path latency lane "
+             "(pull/top-k/CTR-score qps + p50/p95/p99; valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -1453,6 +1509,8 @@ def main(argv=None):
     watchdog.start()
     if args.lane == "chaos":
         return run_chaos_lane()
+    if args.lane == "serve":
+        return run_serve_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

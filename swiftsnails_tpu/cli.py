@@ -11,6 +11,7 @@ Usage::
 
     python -m swiftsnails_tpu train  -config train.conf [-data corpus.txt]
     python -m swiftsnails_tpu export -config train.conf -checkpoint ROOT -out vec.txt
+    python -m swiftsnails_tpu serve  -config train.conf -checkpoint ROOT   # query REPL
     python -m swiftsnails_tpu models
     python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
@@ -94,6 +95,83 @@ def cmd_export(argv: List[str]) -> int:
     return 0
 
 
+def _serve_mesh(cfg: Config):
+    """The serving twin of ``_build_trainer``'s mesh heuristic: query-only
+    replicas shard the table the same way training did."""
+    import jax
+
+    from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
+
+    n = len(jax.devices())
+    if cfg.get_bool("local_train", False) or n == 1:
+        return None
+    model_axis = cfg.get_int("model_axis", 0)
+    if model_axis <= 0:
+        model_axis = next((c for c in (4, 2, 1) if n % c == 0 and n > c), 1)
+    return make_mesh({DATA_AXIS: n // model_axis, MODEL_AXIS: model_axis})
+
+
+def cmd_serve(argv: List[str]) -> int:
+    """Query-only REPL over a verified checkpoint (docs/SERVING.md).
+
+    One request per stdin line, one JSON response per stdout line::
+
+        pull <id> [id...]            row values
+        topk <id> [k]                nearest rows to row <id> (cosine)
+        score <f0> <f1> ...          CTR probability (registry models)
+        stats                        latency/cache/shed snapshot
+        quit
+    """
+    import json
+
+    from swiftsnails_tpu.serving import Overloaded, Servant
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    cfg = parse_role_argv(argv)
+    root = cfg.get_str("checkpoint")
+    ledger_path = cfg.get_str("ledger_path", "")
+    ledger = Ledger(ledger_path) if ledger_path else None
+    with Servant.from_checkpoint(root, cfg, mesh=_serve_mesh(cfg),
+                                 ledger=ledger) as servant:
+        print(
+            f"serving step {servant.step} tables "
+            f"{servant.stats()['tables']} (one request per line; "
+            "pull/topk/score/stats/quit)",
+            file=sys.stderr,
+        )
+        for line in sys.stdin:
+            toks = line.split()
+            if not toks:
+                continue
+            op, args = toks[0], toks[1:]
+            try:
+                if op in ("quit", "exit"):
+                    break
+                elif op == "pull":
+                    rows = servant.pull([int(a) for a in args])
+                    out = {"rows": [[round(float(v), 6) for v in r]
+                                    for r in rows]}
+                elif op == "topk":
+                    row = int(args[0])
+                    k = int(args[1]) if len(args) > 1 else None
+                    query = servant.pull([row])[0]
+                    out = {"topk": servant.topk(query, k=k, exclude=(row,))}
+                elif op == "score":
+                    scores = servant.score([int(a) for a in args])
+                    out = {"scores": [round(float(s), 6) for s in scores]}
+                elif op == "stats":
+                    out = servant.stats()
+                else:
+                    out = {"error": f"unknown op {op!r}"}
+            except Overloaded as e:
+                out = {"error": f"overloaded: {e}", "shed": True}
+            except Exception as e:  # noqa: BLE001 — a REPL must not die
+                out = {"error": f"{type(e).__name__}: {e}"}
+            print(json.dumps(out), flush=True)
+        print(json.dumps({"final_stats": servant.stats()}), flush=True)
+    return 0
+
+
 def cmd_models(argv: List[str]) -> int:
     from swiftsnails_tpu.models.registry import available_models
 
@@ -139,6 +217,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_train(rest)
         if cmd == "export":
             return cmd_export(rest)
+        if cmd == "serve":
+            return cmd_serve(rest)
         if cmd == "models":
             return cmd_models(rest)
         if cmd == "trace-summary":
@@ -149,7 +229,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
             return 0
         print(
-            f"unknown command {cmd!r}; try: train, export, models, "
+            f"unknown command {cmd!r}; try: train, export, serve, models, "
             "trace-summary, ledger-report",
             file=sys.stderr,
         )

@@ -41,7 +41,7 @@ import re
 import shutil
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -106,6 +106,24 @@ def _array_leaves(state: Any) -> List[Tuple[str, Any]]:
     return out
 
 
+_KEY_TOKEN_RE = re.compile(r"\['([^']*)'\]|\.([A-Za-z_0-9]+)|\[(\d+)\]")
+
+
+def canonical_key(keystr: str) -> str:
+    """Layout-independent form of a pytree keypath string.
+
+    orbax's template-less restore turns NamedTuple attributes into dict
+    keys, so the same leaf keystrs differently before save
+    (``['in_table'].table``) and after a query-only restore
+    (``['in_table']['table']``). Both normalize to ``in_table/table`` here,
+    letting :func:`verify_state` match CRC records across the two shapes.
+    """
+    tokens = _KEY_TOKEN_RE.findall(keystr)
+    if not tokens:
+        return keystr
+    return "/".join(a or b or c for a, b, c in tokens)
+
+
 def build_manifest(
     state: Any,
     step: int,
@@ -155,10 +173,14 @@ def verify_state(state: Any, manifest: Dict) -> List[str]:
     recorded = manifest.get("arrays")
     if not isinstance(recorded, dict) or not recorded:
         return ["manifest has no array records"]
+    # canonical key space: manifests record the saving state's keystrs
+    # (NamedTuple attrs), but a template-less restore hands back nested
+    # dicts — same leaves, different keypath spelling
+    canon = {canonical_key(k): v for k, v in recorded.items()}
     seen = set()
     for key, leaf in _array_leaves(state):
-        meta = recorded.get(key)
-        seen.add(key)
+        meta = canon.get(canonical_key(key))
+        seen.add(canonical_key(key))
         if meta is None:
             problems.append(f"{key}: not in manifest")
             continue
@@ -183,7 +205,7 @@ def verify_state(state: Any, manifest: Dict) -> List[str]:
                 continue
         if int(crc) != int(meta.get("crc", -1)):
             problems.append(f"{key}: crc mismatch (corrupt bytes)")
-    missing = set(recorded) - seen
+    missing = set(canon) - seen
     for key in sorted(missing):
         problems.append(f"{key}: in manifest but absent from restored state")
     return problems
@@ -345,6 +367,67 @@ def intact_steps(root: str) -> List[int]:
     without one are either legacy saves or torn writes — restore still
     accepts legacy dirs, but they never count as *verified*."""
     return [s for s in reversed(all_steps(root)) if read_manifest(root, s)]
+
+
+def candidate_steps(root: str, preferred: Sequence[int] = ()) -> List[int]:
+    """Restore candidates under ``root``, best first.
+
+    The one manifest-walk ordering shared by the training resume path
+    (``resilience/resume.py``) and the query-only serving loader
+    (:func:`load_tables`): steps with a committed manifest outrank torn or
+    legacy dirs of any age, newer outranks older within each tier.
+    ``preferred`` (e.g. the ledger's known-good steps) seeds the candidate
+    list but never adds steps that are not on disk.
+    """
+    disk = list(reversed(all_steps(root)))  # newest first, torn dirs included
+    if not disk:
+        return []
+    on_disk = set(disk)
+    candidates: List[int] = [s for s in preferred if s in on_disk]
+    candidates.extend(s for s in disk if s not in candidates)
+    intact = set(intact_steps(root))
+    candidates.sort(key=lambda s: (s in intact, s), reverse=True)
+    return candidates
+
+
+def load_tables(
+    root: str, step: Optional[int] = None, verify: bool = True
+) -> Tuple[Any, Dict]:
+    """Query-only restore: ``(state_tree, manifest)`` with no trainer needed.
+
+    The trainer restore path (:func:`restore_checkpoint`) requires a
+    freshly-initialized template for structure/shardings; a serving process
+    has no trainer, so this loads the checkpoint template-less (orbax
+    rebuilds the tree as nested dicts — NamedTuple levels become plain
+    dicts) and verifies the bytes against the step's committed manifest in
+    canonical key space (:func:`canonical_key`). With ``step=None`` the
+    candidates are walked best-first (:func:`candidate_steps`) and the
+    newest restorable+verified one wins; every rejection is collected into
+    the final :class:`CheckpointError` if nothing survives.
+    """
+    wait_for_checkpoints()  # never read past an in-flight async save
+    steps = [int(step)] if step is not None else candidate_steps(root)
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {root}")
+    ckptr = _checkpointer()
+    rejections: List[str] = []
+    for s in steps:
+        path = _step_dir(root, s)
+        try:
+            restored = ckptr.restore(path)
+        except Exception as e:
+            rejections.append(f"step_{s}: {type(e).__name__}: {e}")
+            continue
+        manifest = read_manifest(root, s)
+        if verify and manifest is not None:
+            problems = verify_state(restored, manifest)
+            if problems:
+                rejections.append(f"step_{s}: " + "; ".join(problems[:4]))
+                continue
+        return restored, (manifest or {"step": s})
+    raise CheckpointError(
+        f"no restorable checkpoint under {root}: " + " | ".join(rejections[:4])
+    )
 
 
 # -------------------------------------------------------------- retention ---

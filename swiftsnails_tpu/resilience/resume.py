@@ -59,22 +59,17 @@ def resume_state(
     not the run.
     """
     from swiftsnails_tpu.framework.checkpoint import (
-        all_steps, intact_steps, read_manifest, restore_checkpoint, _step_dir,
+        candidate_steps, read_manifest, restore_checkpoint, _step_dir,
     )
 
-    disk = list(reversed(all_steps(root)))  # newest first, torn dirs included
-    if not disk:
-        return None
-    candidates: List[int] = []
+    preferred: List[int] = []
     if mode == "auto":
-        candidates.extend(
-            s for s in _ledger_known_steps(ledger, root, config_hash)
-            if s in set(disk)
-        )
-    candidates.extend(s for s in disk if s not in candidates)
-    # steps with a committed manifest outrank torn/legacy dirs of any age
-    intact = set(intact_steps(root))
-    candidates.sort(key=lambda s: (s in intact, s), reverse=True)
+        preferred = _ledger_known_steps(ledger, root, config_hash)
+    # shared walk ordering (also the serving loader's): intact-manifest
+    # steps outrank torn dirs, newest first within each tier
+    candidates = candidate_steps(root, preferred=preferred)
+    if not candidates:
+        return None
 
     for step in candidates:
         try:

@@ -1,0 +1,35 @@
+"""Query-only serving runtime: the read path of the parameter server.
+
+Load a verified checkpoint into read-only sharded tables and serve three
+jitted query kernels — row pull, top-k nearest-neighbor, CTR score — behind
+a micro-batcher with a hot-row LRU cache and bounded-queue admission
+control. See ``docs/SERVING.md``.
+"""
+
+from swiftsnails_tpu.serving.cache import HotRowCache
+from swiftsnails_tpu.serving.engine import (
+    MicroBatcher,
+    Overloaded,
+    Servant,
+    bucket_for,
+    normalize_table,
+)
+from swiftsnails_tpu.serving.kernels import (
+    ctr_logits,
+    ctr_scores,
+    pull_rows,
+    topk_tiled,
+)
+
+__all__ = [
+    "HotRowCache",
+    "MicroBatcher",
+    "Overloaded",
+    "Servant",
+    "bucket_for",
+    "ctr_logits",
+    "ctr_scores",
+    "normalize_table",
+    "pull_rows",
+    "topk_tiled",
+]

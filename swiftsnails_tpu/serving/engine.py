@@ -1,0 +1,683 @@
+"""The serving engine: micro-batched, cached, admission-controlled queries.
+
+The reference system's whole point is a sharded key->value store that
+serves *pull* traffic (PAPER §0: "serves heavy traffic from millions of
+users"); PRs 1-5 built the write/train side only. :class:`Servant` is the
+read path: it owns normalized read-only tables (dense ``[capacity, dim]``
+device arrays produced by :func:`normalize_table` from any checkpointed
+plane) and answers three request kinds through per-kernel micro-batchers:
+
+* ``pull(ids)``    — row lookup (:func:`serving.kernels.pull_rows`)
+* ``topk(query)``  — nearest-neighbor scan (:func:`serving.kernels.topk_tiled`)
+* ``score(feats)`` — CTR forward over pulled rows (registry model)
+
+**Micro-batcher.** Concurrent requests coalesce into fixed padded shapes:
+request units (rows / queries) are concatenated, chunked at the largest
+configured bucket, and each chunk pads up to the smallest bucket that holds
+it — so the jit cache holds at most ``len(serve_batch_buckets)`` entries per
+kernel. Pull padding uses sentinel row id 0; pad rows are sliced off before
+results return, are **never** inserted into the hot-row cache, and are
+counted in ``serve.<k>.pad_rows`` rather than the real-row counters.
+
+**Hot-row cache.** An LRU keyed on ``(table, row_id)`` and stamped with the
+servant's table *version*; :meth:`Servant.reload` bumps the version so a
+table swap invalidates every cached row at once (``docs/SERVING.md``).
+
+**Admission control.** Each batcher's queue is bounded
+(``serve_queue_depth``); a submit against a full queue sheds immediately
+with a typed :class:`Overloaded` instead of stalling the caller, counts a
+shed, and (rate-limited) records an ``overload`` ledger event that
+``ledger-report --failures`` renders.
+
+Latency histograms (p50/p95/p99) and cache-hit/shed counters feed the
+shared telemetry :class:`~swiftsnails_tpu.telemetry.registry.MetricRegistry`
+and the run ledger.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.serving.cache import HotRowCache
+from swiftsnails_tpu.serving.kernels import pull_rows, topk_tiled
+
+DEFAULT_BUCKETS = (8, 64)
+DEFAULT_CACHE_ROWS = 4096
+DEFAULT_QUEUE_DEPTH = 64
+DEFAULT_TOPK = 10
+PAD_ROW = 0  # pull-pad sentinel: a real row id, sliced off before returning
+PAD_FIELD = -1  # CTR pad field (masked out of the forward, as in training)
+_LATENCY_WINDOW = 4096
+_REQUEST_TIMEOUT_S = 120.0
+
+
+class Overloaded(RuntimeError):
+    """The serve queue is full: the request was shed, not queued."""
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest configured bucket that holds ``n`` units (callers chunk at
+    the largest bucket first, so ``n <= max(buckets)`` here)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+# ---------------------------------------------------------- normalization ---
+
+
+def normalize_table(
+    arr,
+    dim: int,
+    layout: str,
+    capacity: Optional[int] = None,
+):
+    """Any checkpointed table plane -> dense ``[capacity, dim]`` rows.
+
+    ``layout``: ``dense`` (2-D ``[C, dim]``, as-is), ``packed`` (word2vec
+    ``[C, S, 128]``, one logical row per tile — ``ops/rowdma.unpack_rows``),
+    or ``packed_small`` (CTR ``[T, S, 128]``, ``small_group(dim)`` rows per
+    tile, sublane 0 = params). Every case is an exact lane select — no
+    arithmetic — so normalized rows are bit-identical to the trained ones.
+    """
+    a = jnp.asarray(arr)
+    if layout == "dense":
+        return a
+    if layout == "packed":
+        from swiftsnails_tpu.ops.rowdma import unpack_rows
+
+        return unpack_rows(a, dim)
+    if layout == "packed_small":
+        from swiftsnails_tpu.ops.rowdma import ROW_LANES
+        from swiftsnails_tpu.parallel.store import small_group
+
+        g = small_group(dim)
+        stride = ROW_LANES // g
+        t = a.shape[0]
+        cap = capacity if capacity is not None else t * g
+        # sublane 0 = params (sublane 1, when present, is the fused AdaGrad
+        # accumulator); row r lives in tile r//g at lanes (r%g)*stride
+        rows = a[:, 0, :].reshape(t * g, stride)
+        return rows[:cap, :dim]
+    raise ValueError(f"unknown table layout {layout!r}")
+
+
+# ------------------------------------------------------------ micro-batch ---
+
+
+class _Request:
+    __slots__ = ("payload", "n", "event", "result", "error", "t0")
+
+    def __init__(self, payload: Dict, n: int):
+        self.payload = payload
+        self.n = n
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t0 = time.perf_counter()
+
+
+class MicroBatcher:
+    """Bounded-queue request coalescer with a dispatcher thread.
+
+    ``dispatch(batch)`` receives a list of :class:`_Request` whose total
+    units fit the largest bucket; it must set each request's ``result`` (or
+    ``error``) and ``event``. Submits against a full queue raise
+    :class:`Overloaded` (after invoking ``on_shed``) — callers never stall.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        buckets: Sequence[int],
+        queue_depth: int,
+        dispatch,
+        linger_s: float = 0.0,
+        on_shed=None,
+    ):
+        self.name = name
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.queue_depth = int(queue_depth)
+        self.linger_s = float(linger_s)
+        self._dispatch = dispatch
+        self._on_shed = on_shed
+        self._queue: "deque[_Request]" = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self.shed = 0
+        self._thread = threading.Thread(
+            target=self._loop, name=f"ssn-serve-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, payload: Dict, n: int) -> _Request:
+        req = _Request(payload, n)
+        with self._cv:
+            if self._closed:
+                raise RuntimeError(f"{self.name} batcher is closed")
+            if len(self._queue) >= self.queue_depth:
+                self.shed += 1
+                if self._on_shed is not None:
+                    self._on_shed(self.name)
+                raise Overloaded(
+                    f"{self.name} queue full "
+                    f"({len(self._queue)}/{self.queue_depth}); request shed"
+                )
+            self._queue.append(req)
+            self._cv.notify()
+        return req
+
+    def _take_batch(self) -> List[_Request]:
+        """Drain queued requests up to the largest bucket's unit budget."""
+        batch: List[_Request] = []
+        units = 0
+        cap = self.buckets[-1]
+        while self._queue and units + self._queue[0].n <= cap:
+            req = self._queue.popleft()
+            batch.append(req)
+            units += req.n
+        if not batch and self._queue:
+            # one oversized request: dispatch chunks it internally
+            batch.append(self._queue.popleft())
+        return batch
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._queue:
+                    return
+                if self.linger_s > 0 and len(self._queue) == 1:
+                    self._cv.wait(timeout=self.linger_s)
+                batch = self._take_batch()
+            if not batch:
+                continue
+            try:
+                self._dispatch(batch)
+            except BaseException as e:  # noqa: BLE001 — fail the batch, not the thread
+                for req in batch:
+                    if not req.event.is_set():
+                        req.error = e
+                        req.event.set()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5.0)
+
+
+def _wait(req: _Request):
+    if not req.event.wait(timeout=_REQUEST_TIMEOUT_S):
+        raise TimeoutError("serving request timed out")
+    if req.error is not None:
+        raise req.error
+    return req.result
+
+
+def _percentile(samples: List[float], p: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    return s[min(int(p * (len(s) - 1)), len(s) - 1)]
+
+
+# ---------------------------------------------------------------- servant ---
+
+
+class Servant:
+    """In-process query API over normalized read-only tables.
+
+    ``tables``: name -> dense ``[capacity, dim]`` device array.
+    ``scorer``: a registry CTR trainer instance (forward + feature hashing)
+    when the ``score`` kernel should be live; ``dense`` is its checkpointed
+    dense pytree. ``registry`` is a telemetry
+    :class:`~swiftsnails_tpu.telemetry.registry.MetricRegistry` (a private
+    one is created when omitted); ``ledger`` receives ``overload`` events.
+    """
+
+    def __init__(
+        self,
+        tables: Dict[str, Any],
+        *,
+        manifest: Optional[Dict] = None,
+        mesh=None,
+        scorer=None,
+        dense=None,
+        registry=None,
+        ledger=None,
+        batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
+        cache_rows: int = DEFAULT_CACHE_ROWS,
+        queue_depth: int = DEFAULT_QUEUE_DEPTH,
+        linger_s: float = 0.0,
+        comm_dtype: str = "float32",
+        topk: int = DEFAULT_TOPK,
+        topk_tile_rows: int = 4096,
+        default_table: Optional[str] = None,
+    ):
+        if not tables:
+            raise ValueError("Servant needs at least one table")
+        self.mesh = mesh
+        self.comm_dtype = comm_dtype
+        self.topk_default = int(topk)
+        self.topk_tile_rows = int(topk_tile_rows)
+        self.scorer = scorer
+        self.ledger = ledger
+        self.manifest = manifest or {}
+        self.step = int(self.manifest.get("step", 0) or 0)
+        self.version = 0  # bumped by every reload; keys the hot-row cache
+        self._tables = {k: jnp.asarray(v) for k, v in tables.items()}
+        self._dense = dense if dense is not None else {}
+        self.default_table = default_table or (
+            "in_table" if "in_table" in self._tables else
+            sorted(self._tables)[0]
+        )
+        self.buckets = tuple(sorted(int(b) for b in batch_buckets))
+
+        if registry is None:
+            from swiftsnails_tpu.telemetry.registry import MetricRegistry
+
+            registry = MetricRegistry()
+        self.registry = registry
+        self.cache = HotRowCache(cache_rows)
+        self._latency: Dict[str, "deque[float]"] = {
+            k: deque(maxlen=_LATENCY_WINDOW)
+            for k in ("pull", "topk", "score")
+        }
+        self._shed_events = 0  # overload ledger events already written
+        self._lock = threading.Lock()
+
+        self._pull_fn = jax.jit(
+            lambda table, rows: pull_rows(
+                table, rows, mesh=self.mesh, comm_dtype=self.comm_dtype
+            )
+        )
+        self._score_fn = jax.jit(self._score_impl) if scorer is not None else None
+
+        self._batchers = {
+            "pull": MicroBatcher(
+                "pull", self.buckets, queue_depth, self._dispatch_pull,
+                linger_s=linger_s, on_shed=self._note_shed,
+            ),
+            "topk": MicroBatcher(
+                "topk", self.buckets, queue_depth, self._dispatch_topk,
+                linger_s=linger_s, on_shed=self._note_shed,
+            ),
+            "score": MicroBatcher(
+                "score", self.buckets, queue_depth, self._dispatch_score,
+                linger_s=linger_s, on_shed=self._note_shed,
+            ),
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        root: str,
+        config,
+        *,
+        step: Optional[int] = None,
+        mesh=None,
+        **kwargs,
+    ) -> "Servant":
+        """Load a verified checkpoint into a query-only servant.
+
+        ``config`` is the same typed config the training run used — it
+        carries the model family and table geometry the checkpointed arrays
+        are laid out with (``model``, ``dim``/``num_fields``, ``packed``,
+        ``capacity``), plus the ``serve_*`` knobs.
+        """
+        from swiftsnails_tpu.framework.checkpoint import load_tables
+
+        state, manifest = load_tables(root, step=step)
+        model_name = config.get_str("model", "word2vec")
+        scorer = dense = None
+        if model_name == "word2vec":
+            dim = config.get_int("dim", 100)
+            layout = "packed" if config.get_bool("packed", True) else "dense"
+            tables = {
+                name: normalize_table(state[name]["table"], dim, layout)
+                for name in ("in_table", "out_table")
+                if name in state
+            }
+            default_table = "in_table"
+        else:
+            from swiftsnails_tpu.models.registry import get_model
+
+            trainer_cls = get_model(model_name)
+            # a scorer instance carries forward() + the feature hashing; the
+            # empty data tuple keeps the constructor off the data path
+            n_fields = config.get_int("num_fields")
+            scorer = trainer_cls(
+                config, mesh=None,
+                data=(np.zeros(0, np.float32),
+                      np.zeros((0, n_fields), np.int32)),
+            )
+            layout = "packed_small" if scorer.packed else "dense"
+            tables = {
+                "table": normalize_table(
+                    state["table"]["table"], scorer.table_dim, layout,
+                    capacity=scorer.capacity,
+                )
+            }
+            dense = state.get("dense") or {}
+            default_table = "table"
+        if mesh is not None:
+            from swiftsnails_tpu.parallel.mesh import table_sharding
+
+            sharding = table_sharding(mesh)
+            tables = {k: jax.device_put(v, sharding) for k, v in tables.items()}
+        kwargs.setdefault("batch_buckets", _int_list(
+            config.get_str("serve_batch_buckets", ""), DEFAULT_BUCKETS))
+        kwargs.setdefault("cache_rows",
+                          config.get_int("serve_cache_rows", DEFAULT_CACHE_ROWS))
+        kwargs.setdefault("queue_depth",
+                          config.get_int("serve_queue_depth", DEFAULT_QUEUE_DEPTH))
+        kwargs.setdefault("topk", config.get_int("serve_topk", DEFAULT_TOPK))
+        kwargs.setdefault("comm_dtype", config.get_str("comm_dtype", "float32"))
+        return cls(
+            tables, manifest=manifest, mesh=mesh, scorer=scorer, dense=dense,
+            default_table=default_table, **kwargs,
+        )
+
+    def reload(self, tables: Dict[str, Any], manifest: Optional[Dict] = None,
+               dense=None) -> int:
+        """Swap in new tables; bumps the version so every cached row of the
+        old tables misses (stale rows can never be served)."""
+        with self._lock:
+            self._tables = {k: jnp.asarray(v) for k, v in tables.items()}
+            if dense is not None:
+                self._dense = dense
+            if manifest is not None:
+                self.manifest = manifest
+                self.step = int(manifest.get("step", self.step) or 0)
+            self.version += 1
+            return self.version
+
+    def close(self) -> None:
+        for b in self._batchers.values():
+            b.close()
+        self._flush_overloads(final=True)
+
+    def __enter__(self) -> "Servant":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- request API -------------------------------------------------------
+
+    def pull(self, ids, table: Optional[str] = None) -> np.ndarray:
+        """[N] row ids -> [N, dim] rows (cache -> micro-batch -> kernel)."""
+        t0 = time.perf_counter()
+        name = table or self.default_table
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        version = self.version
+        found, missing = self.cache.get_many(name, version, ids)
+        if missing:
+            req = self._batchers["pull"].submit(
+                {"table": name, "ids": np.asarray(missing, np.int32),
+                 "version": version},
+                n=len(missing),
+            )
+            pulled = _wait(req)  # [len(missing), dim]
+            found.update(
+                (int(i), pulled[n]) for n, i in enumerate(missing)
+            )
+        out = np.stack([found[int(i)] for i in ids]) if len(ids) else \
+            np.zeros((0,) + self._tables[name].shape[1:], np.float32)
+        self._observe("pull", t0, units=len(ids))
+        return out
+
+    def topk(
+        self,
+        query,
+        k: Optional[int] = None,
+        table: Optional[str] = None,
+        exclude: Sequence[int] = (),
+        normalize: bool = True,
+    ) -> List[Tuple[int, float]]:
+        """Nearest rows to ``query`` ([dim]) by cosine (or raw dot) score.
+
+        ``exclude`` ids are filtered host-side (the kernel scans the full
+        table); the request over-fetches by ``len(exclude)`` to compensate.
+        """
+        t0 = time.perf_counter()
+        name = table or self.default_table
+        k = int(k or self.topk_default)
+        q = np.asarray(query, np.float32).reshape(1, -1)
+        req = self._batchers["topk"].submit(
+            {"table": name, "queries": q, "k": k + len(exclude),
+             "normalize": normalize},
+            n=1,
+        )
+        scores, ids = _wait(req)  # ([1, k+x], [1, k+x])
+        out = [
+            (int(i), float(s))
+            for i, s in zip(ids[0], scores[0])
+            if int(i) not in set(int(e) for e in exclude) and int(i) >= 0
+        ][:k]
+        self._observe("topk", t0, units=1)
+        return out
+
+    def score(self, feats) -> np.ndarray:
+        """CTR probability scores for ``feats`` [B, F] (or [F])."""
+        if self.scorer is None:
+            raise RuntimeError("this servant has no CTR scorer model")
+        t0 = time.perf_counter()
+        feats = np.asarray(feats, np.int32)
+        if feats.ndim == 1:
+            feats = feats[None, :]
+        req = self._batchers["score"].submit({"feats": feats}, n=len(feats))
+        out = _wait(req)
+        self._observe("score", t0, units=len(feats))
+        return out
+
+    # -- dispatch (batcher thread) ----------------------------------------
+
+    def _dispatch_pull(self, batch: List[_Request]) -> None:
+        by_table: Dict[str, List[_Request]] = {}
+        for req in batch:
+            by_table.setdefault(req.payload["table"], []).append(req)
+        for name, reqs in by_table.items():
+            ids = np.concatenate([r.payload["ids"] for r in reqs])
+            rows = self._pull_padded(name, ids)
+            # split back per request; insert REAL rows into the cache (pad
+            # rows never reach here — _pull_padded slices them off)
+            version = reqs[0].payload["version"]
+            if version == self.version:
+                self.cache.put_many(name, version, ids, rows)
+            off = 0
+            for req in reqs:
+                req.result = rows[off : off + req.n]
+                off += req.n
+                req.event.set()
+
+    def _pull_padded(self, name: str, ids: np.ndarray) -> np.ndarray:
+        """Chunk at the largest bucket, pad each chunk to its bucket with
+        the sentinel row, pull, slice the pads off. Pad rows are excluded
+        from the pulled-rows counter (they count as ``pad_rows``) and are
+        never cached."""
+        table = self._tables[name]
+        cap = self.buckets[-1]
+        out: List[np.ndarray] = []
+        for lo in range(0, len(ids), cap):
+            chunk = ids[lo : lo + cap]
+            b = bucket_for(len(chunk), self.buckets)
+            pad = b - len(chunk)
+            padded = np.concatenate(
+                [chunk, np.full(pad, PAD_ROW, np.int32)]
+            ) if pad else chunk
+            vals = np.asarray(self._pull_fn(table, jnp.asarray(padded)))
+            out.append(vals[: len(chunk)])
+            self.registry.counter("serve.pull.rows").inc(len(chunk))
+            self.registry.counter("serve.pull.pad_rows").inc(pad)
+        return np.concatenate(out) if out else np.zeros(
+            (0, table.shape[1]), np.float32)
+
+    def _dispatch_topk(self, batch: List[_Request]) -> None:
+        by_key: Dict[Tuple[str, int, bool], List[_Request]] = {}
+        for req in batch:
+            p = req.payload
+            by_key.setdefault(
+                (p["table"], p["k"], p["normalize"]), []
+            ).append(req)
+        for (name, k, normalize), reqs in by_key.items():
+            table = self._tables[name]
+            queries = np.concatenate([r.payload["queries"] for r in reqs])
+            cap = self.buckets[-1]
+            all_s: List[np.ndarray] = []
+            all_i: List[np.ndarray] = []
+            for lo in range(0, len(queries), cap):
+                chunk = queries[lo : lo + cap]
+                b = bucket_for(len(chunk), self.buckets)
+                pad = b - len(chunk)
+                padded = np.concatenate(
+                    [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
+                ) if pad else chunk
+                s, i = topk_tiled(
+                    table, jnp.asarray(padded), k=k,
+                    tile_rows=self.topk_tile_rows, normalize=normalize,
+                )
+                all_s.append(np.asarray(s)[: len(chunk)])
+                all_i.append(np.asarray(i)[: len(chunk)])
+                self.registry.counter("serve.topk.queries").inc(len(chunk))
+                self.registry.counter("serve.topk.pad_rows").inc(pad)
+            s = np.concatenate(all_s)
+            i = np.concatenate(all_i)
+            off = 0
+            for req in reqs:
+                req.result = (s[off : off + req.n], i[off : off + req.n])
+                off += req.n
+                req.event.set()
+
+    def _score_impl(self, table, dense, feats):
+        b, f = feats.shape
+        mask = feats >= 0
+        rows = self.scorer._rows(feats).reshape(-1)
+        pulled = pull_rows(
+            table, rows, mesh=self.mesh, comm_dtype=self.comm_dtype
+        ).reshape(b, f, self.scorer.table_dim)
+        logits = self.scorer.forward(pulled, dense, mask)
+        return jax.nn.sigmoid(logits)
+
+    def _dispatch_score(self, batch: List[_Request]) -> None:
+        table = self._tables[self.default_table]
+        feats = np.concatenate([r.payload["feats"] for r in batch])
+        cap = self.buckets[-1]
+        outs: List[np.ndarray] = []
+        for lo in range(0, len(feats), cap):
+            chunk = feats[lo : lo + cap]
+            b = bucket_for(len(chunk), self.buckets)
+            pad = b - len(chunk)
+            padded = np.concatenate(
+                [chunk, np.full((pad, chunk.shape[1]), PAD_FIELD, np.int32)]
+            ) if pad else chunk
+            scores = np.asarray(
+                self._score_fn(table, self._dense, jnp.asarray(padded))
+            )
+            outs.append(scores[: len(chunk)])
+            self.registry.counter("serve.score.rows").inc(len(chunk))
+            self.registry.counter("serve.score.pad_rows").inc(pad)
+        scores = np.concatenate(outs)
+        off = 0
+        for req in batch:
+            req.result = scores[off : off + req.n]
+            off += req.n
+            req.event.set()
+
+    # -- metrics -----------------------------------------------------------
+
+    def _observe(self, kernel: str, t0: float, units: int) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        self._latency[kernel].append(ms)
+        self.registry.histogram(f"serve.{kernel}.latency_ms").observe(ms)
+        self.registry.counter(f"serve.{kernel}.requests").inc()
+
+    def _note_shed(self, kernel: str) -> None:
+        self.registry.counter(f"serve.{kernel}.shed").inc()
+        self.registry.counter("serve.shed").inc()
+        total = int(self.registry.counter("serve.shed").value)
+        # rate-limited overload events: the first shed and every 100th after
+        if self.ledger is not None and (total == 1 or total % 100 == 0):
+            self._append_overload(kernel, total)
+
+    def _append_overload(self, kernel: str, total: int) -> None:
+        try:
+            self.ledger.append("overload", {
+                "source": "serving",
+                "kernel": kernel,
+                "shed_total": total,
+                "queue_depth": self._batchers[kernel].queue_depth,
+            })
+            self._shed_events = total
+        except Exception:
+            pass  # record-keeping never blocks the serve path
+
+    def _flush_overloads(self, final: bool = False) -> None:
+        total = int(self.registry.counter("serve.shed").value)
+        if final and self.ledger is not None and total > self._shed_events:
+            self._append_overload("all", total)
+
+    def shed_count(self) -> int:
+        return int(self.registry.counter("serve.shed").value)
+
+    def reset_metrics(self) -> None:
+        for d in self._latency.values():
+            d.clear()
+        self.cache.hits = 0
+        self.cache.misses = 0
+
+    def stats(self) -> Dict:
+        kernels = {}
+        for name, samples in self._latency.items():
+            s = list(samples)
+            kernels[name] = {
+                "count": len(s),
+                "mean_ms": round(float(np.mean(s)), 4) if s else 0.0,
+                "p50_ms": round(_percentile(s, 0.50), 4),
+                "p95_ms": round(_percentile(s, 0.95), 4),
+                "p99_ms": round(_percentile(s, 0.99), 4),
+            }
+        reg = self.registry
+        return {
+            "version": self.version,
+            "step": self.step,
+            "tables": {k: list(v.shape) for k, v in self._tables.items()},
+            "kernels": kernels,
+            "cache": {
+                "rows": len(self.cache),
+                "capacity": self.cache.capacity,
+                "hits": self.cache.hits,
+                "misses": self.cache.misses,
+                "hit_rate": round(self.cache.hit_rate, 4),
+            },
+            "shed": {
+                k: int(reg.counter(f"serve.{k}.shed").value)
+                for k in ("pull", "topk", "score")
+            },
+            "shed_total": self.shed_count(),
+            "pad_rows": {
+                k: int(reg.counter(f"serve.{k}.pad_rows").value)
+                for k in ("pull", "topk", "score")
+            },
+        }
+
+
+def _int_list(raw: str, default: Sequence[int]) -> Tuple[int, ...]:
+    """Parse a ``serve_batch_buckets``-style comma list, e.g. ``8,64``."""
+    raw = (raw or "").strip()
+    if not raw:
+        return tuple(default)
+    return tuple(int(tok) for tok in raw.replace(",", " ").split())

@@ -1,0 +1,140 @@
+"""The three jitted query kernels of the serving read path.
+
+Every kernel operates on a *normalized* read-only table — a dense 2-D
+``[capacity, dim]`` float array produced at load time by
+:func:`swiftsnails_tpu.serving.engine.normalize_tables` from whatever plane
+the trainer checkpointed (2-D, word2vec packed ``[C, S, 128]``, or the CTR
+small-row packed ``[T, S, 128]``). Normalization is an exact lane select, so
+the f32 wire keeps serving pulls bit-identical to the checkpointed rows.
+
+* :func:`pull_rows` — batched embedding lookup. Under a mesh it reuses the
+  training stack's pull collective (``parallel/transfer.pull_collective``:
+  shard-local gather + psum over ``model``) with the same ``comm_dtype``
+  wire compression; single-device it is the XLA gather with the equivalent
+  wire cast.
+* :func:`topk_tiled` — tiled on-device scan over the full table (the
+  serving twin of ``tools/eval_embeddings.py``'s NumPy scan): per-tile
+  matmul + running top-k merge via ``lax.scan``, so the score matrix never
+  materializes beyond one ``[B, tile_rows]`` block.
+* :func:`ctr_logits` — the registry CTR models' forward pass over pulled
+  rows (mask semantics identical to training: PAD=-1 fields contribute
+  nothing).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
+from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS  # noqa: F401
+from swiftsnails_tpu.parallel.store import TableState
+
+
+def _wire_cast(vals: jax.Array, comm_dtype: str) -> jax.Array:
+    """Single-device twin of the collective wire: the same precision loss
+    the psum-over-model applies, so a 1-chip servant and a mesh servant
+    answer identically for a given ``comm_dtype``. f32 is a no-op
+    (bit-identical pulls)."""
+    if comm_dtype == "bfloat16":
+        return vals.astype(jnp.bfloat16).astype(vals.dtype)
+    return vals  # float32 exact; int8 is a gradient-push wire, not a pull one
+
+
+def pull_rows(
+    table: jax.Array,
+    rows: jax.Array,
+    mesh=None,
+    comm_dtype: str = "float32",
+) -> jax.Array:
+    """[N] row ids -> [N, dim] rows of a normalized read-only table."""
+    comm_dtype = resolve_comm_dtype(comm_dtype)
+    if mesh is not None:
+        from swiftsnails_tpu.parallel.transfer import pull_collective
+
+        return pull_collective(
+            mesh, TableState(table=table, slots={}), rows, comm_dtype
+        )
+    vals = table.at[rows].get(mode="promise_in_bounds")
+    return _wire_cast(vals, comm_dtype)
+
+
+@partial(jax.jit, static_argnames=("k", "tile_rows", "normalize"))
+def topk_tiled(
+    table: jax.Array,
+    queries: jax.Array,
+    k: int,
+    tile_rows: int = 4096,
+    normalize: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-k rows of ``table`` by dot-product score against ``queries``.
+
+    ``table`` [C, D], ``queries`` [B, D] -> (scores [B, k], ids [B, k]),
+    scores descending. With ``normalize`` both sides are L2-normalized
+    (cosine similarity — the eval tool's semantics); pass False to rank raw
+    inner products. The scan walks ``tile_rows``-row tiles carrying the
+    running best-k, so peak memory is one [B, tile_rows] score block
+    regardless of capacity.
+    """
+    c, d = table.shape
+    b = queries.shape[0]
+    k = min(k, c)
+    q = queries.astype(jnp.float32)
+    if normalize:
+        q = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-9)
+    tile_rows = min(tile_rows, c)
+    n_tiles = -(-c // tile_rows)
+    pad = n_tiles * tile_rows - c
+    tbl = table.astype(jnp.float32)
+    if pad:
+        tbl = jnp.pad(tbl, ((0, pad), (0, 0)))
+    if normalize:
+        tbl = tbl / jnp.maximum(
+            jnp.linalg.norm(tbl, axis=-1, keepdims=True), 1e-9
+        )
+    tiles = tbl.reshape(n_tiles, tile_rows, d)
+    bases = jnp.arange(n_tiles, dtype=jnp.int32) * tile_rows
+
+    def body(carry, inp):
+        best_s, best_i = carry
+        tile, base = inp
+        scores = q @ tile.T  # [B, tile_rows]
+        ids = base + jnp.arange(tile_rows, dtype=jnp.int32)
+        scores = jnp.where(ids[None, :] < c, scores, -jnp.inf)
+        cat_s = jnp.concatenate([best_s, scores], axis=1)
+        cat_i = jnp.concatenate(
+            [best_i, jnp.broadcast_to(ids[None, :], (b, tile_rows))], axis=1
+        )
+        top_s, sel = jax.lax.top_k(cat_s, k)
+        top_i = jnp.take_along_axis(cat_i, sel, axis=1)
+        return (top_s, top_i), None
+
+    init = (
+        jnp.full((b, k), -jnp.inf, jnp.float32),
+        jnp.full((b, k), -1, jnp.int32),
+    )
+    (best_s, best_i), _ = jax.lax.scan(body, init, (tiles, bases))
+    return best_s, best_i
+
+
+def ctr_logits(
+    forward: Callable[[jax.Array, Any, jax.Array], jax.Array],
+    pulled: jax.Array,
+    dense: Any,
+    mask: jax.Array,
+) -> jax.Array:
+    """Registry-model forward over pulled rows -> logits [B]."""
+    return forward(pulled, dense, mask)
+
+
+def ctr_scores(
+    forward: Callable[[jax.Array, Any, jax.Array], jax.Array],
+    pulled: jax.Array,
+    dense: Any,
+    mask: jax.Array,
+) -> jax.Array:
+    """CTR probability scores: sigmoid of the model logits."""
+    return jax.nn.sigmoid(ctr_logits(forward, pulled, dense, mask))

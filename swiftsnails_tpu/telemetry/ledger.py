@@ -416,7 +416,7 @@ def render_report(ledger: Ledger) -> str:
 # failure-timeline view: every kind that marks something going wrong (or a
 # chaos drill making it go wrong on purpose), interleaved with run records
 # for context — `ledger-report --failures`
-FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error")
+FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload")
 
 
 def _failure_line(r: Dict) -> str:
@@ -445,6 +445,12 @@ def _failure_line(r: Dict) -> str:
         return (
             f"  {ts}  CKPT/CACHE-ERROR source={r.get('source', 'bench-cache')}"
             f"  {str(r.get('error', ''))[:90]}"
+        )
+    if kind == "overload":
+        return (
+            f"  {ts}  OVERLOAD kernel={r.get('kernel')} "
+            f"shed_total={r.get('shed_total')} "
+            f"queue_depth={r.get('queue_depth')}"
         )
     return f"  {ts}  {kind}"
 
@@ -513,19 +519,31 @@ def check_regression(
     if not measured:
         msg = "check-regression: no measured bench record in ledger"
         # chaos recovery is gated on correctness, not measured perf — a CPU
-        # chaos-lane record must still be able to fail (or pass) CI here
+        # chaos-lane record must still be able to fail (or pass) CI here;
+        # the serve lane gates same-platform, so CPU records count there too
         c_rc, c_msg = _check_chaos_regression(ledger)
         if c_msg:
             msg = f"{msg}\n{c_msg}"
-        return max(2, c_rc), msg
+        v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
+        if v_msg:
+            msg = f"{msg}\n{v_msg}"
+        return max(2, c_rc, v_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
         if not earlier:
-            return 0, (
+            msg = (
                 f"check-regression: single measured record "
                 f"(value={newest:,.1f}); nothing to compare against"
             )
+            # the correctness/latency lanes still gate (CPU records count)
+            c_rc, c_msg = _check_chaos_regression(ledger)
+            if c_msg:
+                msg = f"{msg}\n{c_msg}"
+            v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
+            if v_msg:
+                msg = f"{msg}\n{v_msg}"
+            return max(0, c_rc, v_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -545,7 +563,10 @@ def check_regression(
     c_rc, c_msg = _check_chaos_regression(ledger)
     if c_msg:
         msg = f"{msg}\n{c_msg}"
-    return max(rc, s_rc, c_rc), msg
+    v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
+    if v_msg:
+        msg = f"{msg}\n{v_msg}"
+    return max(rc, s_rc, c_rc, v_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -626,6 +647,72 @@ def _check_chaos_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
     return 0, (
         f"chaos ok: all drills recovered, guard overhead "
         f"{c.get('guard_overhead_pct')}%, resume loss parity {parity}"
+    )
+
+
+def _serving_values(record: Dict) -> Optional[Tuple[float, Optional[float]]]:
+    """(qps, p99_ms) from a bench payload's ``serving`` block, or None when
+    the serve lane didn't run in that record."""
+    s = record.get("payload", {}).get("serving")
+    if not isinstance(s, dict):
+        return None
+    qps = s.get("qps")
+    if not (isinstance(qps, (int, float)) and qps > 0):
+        return None
+    p99 = s.get("p99_ms")
+    p99 = float(p99) if isinstance(p99, (int, float)) and p99 > 0 else None
+    return float(qps), p99
+
+
+def _check_serving_regression(
+    ledger: Ledger, max_drop_pct: float
+) -> Tuple[int, Optional[str]]:
+    """Gate the serve lane's headline (pull qps + p99 latency) alongside the
+    training headline: the newest bench record carrying a ``serving`` block
+    must hold the qps floor AND the p99 ceiling against the best earlier
+    record of the *same platform* (absolute latency is platform-bound, so a
+    CPU record never gates a TPU one — but CPU-vs-CPU CI runs do gate).
+    No serving history (or a single record) gates nothing."""
+    with_serving = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict) and _serving_values(r)
+    ]
+    if not with_serving:
+        return 0, None
+    newest_rec = with_serving[-1]
+    platform = newest_rec["payload"].get("platform")
+    same = [r for r in with_serving
+            if r["payload"].get("platform") == platform]
+    qps, p99 = _serving_values(newest_rec)
+    earlier = [_serving_values(r) for r in same[:-1]]
+    if not earlier:
+        return 0, (
+            f"serving: single {platform or '?'} record (pull {qps:,.1f} qps)"
+            "; nothing to compare against"
+        )
+    base_qps = max(q for q, _ in earlier)
+    qps_floor = base_qps * (1.0 - max_drop_pct / 100.0)
+    problems = []
+    if qps < qps_floor:
+        problems.append(
+            f"pull qps {qps:,.1f} is {(1 - qps / base_qps) * 100:.1f}% below "
+            f"baseline {base_qps:,.1f} (allowed {max_drop_pct:.1f}%)"
+        )
+    earlier_p99 = [p for _, p in earlier if p]
+    if p99 is not None and earlier_p99:
+        base_p99 = min(earlier_p99)
+        p99_ceiling = base_p99 * (1.0 + max_drop_pct / 100.0)
+        if p99 > p99_ceiling:
+            problems.append(
+                f"pull p99 {p99:.2f}ms is {(p99 / base_p99 - 1) * 100:.1f}% "
+                f"above baseline {base_p99:.2f}ms "
+                f"(allowed {max_drop_pct:.1f}%)"
+            )
+    if problems:
+        return 1, "serving REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"serving ok: pull {qps:,.1f} qps / p99 {p99}ms vs "
+        f"qps baseline {base_qps:,.1f} ({platform or '?'})"
     )
 
 

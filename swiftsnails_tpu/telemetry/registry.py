@@ -95,6 +95,7 @@ class Histogram:
                 "min": self.min,
                 "max": self.max,
                 "p50": q(0.50),
+                "p95": q(0.95),
                 "p99": q(0.99),
             }
 

@@ -9,12 +9,21 @@ output can be inspected::
     python tools/eval_embeddings.py vec.txt --neighbors king --topn 10
     python tools/eval_embeddings.py vec.txt --sim cat dog
     python tools/eval_embeddings.py vec.txt --analogy king man woman
+
+Ranking runs on-device through the serving top-k kernel
+(``swiftsnails_tpu.serving.kernels.topk_tiled`` — the same tiled scan a
+``serve`` replica answers ``topk`` queries with), so this tool doubles as
+its offline parity check; vectors here are pre-normalized, so the kernel
+ranks by the same cosine a NumPy ``argsort(-vecs @ q)`` would.
 """
 
 import argparse
+import os
 import sys
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def load_embeddings(path):
@@ -32,13 +41,21 @@ def load_embeddings(path):
 
 
 def nearest(vecs, q, topn, exclude=()):
-    sims = vecs @ q
-    order = np.argsort(-sims)
+    """Top-``topn`` rows by cosine, via the serving kernel's tiled scan;
+    over-fetches by ``len(exclude)`` so filtering can't come up short."""
+    from swiftsnails_tpu.serving.kernels import topk_tiled
+
+    import jax.numpy as jnp
+
+    k = min(topn + len(exclude), len(vecs))
+    scores, ids = topk_tiled(
+        jnp.asarray(vecs), jnp.asarray(q, jnp.float32)[None, :], k=k,
+    )
     out = []
-    for i in order:
-        if i in exclude:
+    for i, s in zip(np.asarray(ids[0]), np.asarray(scores[0])):
+        if int(i) in exclude or int(i) < 0:
             continue
-        out.append((int(i), float(sims[i])))
+        out.append((int(i), float(s)))
         if len(out) >= topn:
             break
     return out
