@@ -128,7 +128,8 @@ _state = {
     "scaling": None,  # multi-chip throughput lane (dict; see measure_scaling)
     "chaos": None,  # resilience lane (dict; see measure_chaos / --lane chaos)
     "serving": None,  # read-path latency lane (dict; see --lane serve)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve)
+    "tiered": None,  # host-tier parameter store lane (dict; see --lane tiered)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -236,6 +237,7 @@ def _result_json(extra_error=None):
             "scaling": _state["scaling"],
             "chaos": _state["chaos"],
             "serving": _state["serving"],
+            "tiered": _state["tiered"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1145,6 +1147,58 @@ def run_serve_lane() -> int:
     return 0
 
 
+# -- tiered (host parameter store) lane ---------------------------------------
+#
+# `--lane tiered` measures the tiered parameter store (`swiftsnails_tpu/
+# tiered/`): words/sec of `table_tier: host` vs the resident store at equal
+# vocab (with bit-parity of the final tables), plus an over-budget leg where
+# the masters are 4x the HBM cache budget and the full train -> checkpoint ->
+# serve round trip must hold exact parity. The budget is synthetic, so the
+# lane is valid on CPU; the block lands in the result JSON (`tiered`), the
+# run ledger, and the `ledger-report --check-regression` gate.
+
+
+def measure_tiered() -> None:
+    """Populate ``_state['tiered']`` with the host-tier lane block."""
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+    from swiftsnails_tpu.tiered.bench_lane import tiered_bench
+
+    block = tiered_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["tiered"] = block
+    print(
+        f"bench: tiered lane: {block.get('words_per_sec')} words/s "
+        f"({block.get('tiered_over_resident')}x resident) "
+        f"parity {block.get('parity_bit_identical')} "
+        f"over-budget round trip {block.get('round_trip_ok')}",
+        file=sys.stderr,
+    )
+
+
+def run_tiered_lane() -> int:
+    """``--lane tiered``: the host-tier store lane alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "tiered"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_tiered()
+    except Exception as e:
+        _state["errors"].append(
+            f"tiered lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["tiered"]
+    # the lane's headline is the tiered path's own words/sec at equal vocab
+    _state["best"] = block.get("words_per_sec") or 0.0
+    _state["best_path"] = "tiered-host"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    return 0
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -1497,11 +1551,13 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="bench", description="word2vec words/sec/chip benchmark")
     parser.add_argument(
-        "--lane", choices=("full", "chaos", "serve"), default="full",
+        "--lane", choices=("full", "chaos", "serve", "tiered"), default="full",
         help="full = the headline bench (default); chaos = the resilience "
              "lane alone (guardrail overhead + scripted-fault recovery "
              "drills; valid on CPU); serve = the read-path latency lane "
-             "(pull/top-k/CTR-score qps + p50/p95/p99; valid on CPU)",
+             "(pull/top-k/CTR-score qps + p50/p95/p99; valid on CPU); "
+             "tiered = the host-tier parameter store lane (words/sec vs "
+             "resident + over-budget round trip; valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -1511,6 +1567,8 @@ def main(argv=None):
         return run_chaos_lane()
     if args.lane == "serve":
         return run_serve_lane()
+    if args.lane == "tiered":
+        return run_tiered_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

@@ -57,6 +57,18 @@ class Vocab:
     def __contains__(self, word: str) -> bool:
         return word in self.index
 
+    def frequency_ranks(self) -> np.ndarray:
+        """Per-id frequency rank (0 = most frequent; ties broken by id, which
+        is already lexicographic under the ordering contract). Vocab ids are
+        frequency-ranked at build time, so for a freshly built vocab this is
+        ``arange``; a loaded/merged vocab may not be sorted, hence the
+        explicit double argsort. Consumers: the tiered store pre-warms its
+        HBM cache with the hottest rows before step 0."""
+        order = np.argsort(-self.counts, kind="stable")
+        ranks = np.empty(len(self.counts), dtype=np.int64)
+        ranks[order] = np.arange(len(self.counts), dtype=np.int64)
+        return ranks
+
     def encode(self, tokens: Iterable[str]) -> np.ndarray:
         """Token stream -> int32 ids, dropping OOV (word2vec convention)."""
         idx = self.index

@@ -281,6 +281,7 @@ def save_checkpoint(
     keep: int = 0,
     protect: Optional[int] = None,
     ledger=None,
+    tier=None,
 ) -> str:
     """Write a sharded checkpoint for ``step`` under ``root`` (param_backup
     parity), committed by a checksum manifest.
@@ -297,7 +298,18 @@ def save_checkpoint(
     restarting it. ``keep > 0`` applies ``param_backup_keep`` retention after
     the manifest commit; ``protect`` is a step that must never be pruned
     (the step this run restored from).
+
+    ``tier`` (a :class:`~swiftsnails_tpu.tiered.TierManager`) makes the save
+    tier-transparent: every dirty cache slot is flushed host-ward FIRST (the
+    write-back invariant — flush-before-manifest), the full-size
+    master-backed state is what gets written (on-disk format identical to a
+    resident run, so restore/serving need no tier awareness), and the write
+    is forced synchronous — an async write would race with later
+    eviction-flushes mutating the NumPy master planes in place.
     """
+    if tier is not None:
+        state = tier.master_state(state)
+        wait = True
     path = _step_dir(root, step)
     manifest = build_manifest(state, step, cursor=cursor, config_hash=config_hash)
     ckptr = _checkpointer()

@@ -87,6 +87,38 @@ class Trainer:
     def eval_metrics(self, state: Any) -> Dict[str, float]:
         return {}
 
+    # -- tiered-store hooks (table_tier: host; see swiftsnails_tpu/tiered) --
+
+    def tier_spec(self) -> Optional[Dict[str, Dict]]:
+        """``{table_name: {"layout": dense|packed|packed_small, "group": G}}``
+        for trainers that support the host tier; ``None`` (default) means
+        ``table_tier: host`` is rejected for this trainer."""
+        return None
+
+    def tier_tables(self, state: Any) -> Dict[str, Any]:
+        """Extract the tierable table states from the state pytree, keyed to
+        match :meth:`tier_spec`."""
+        raise NotImplementedError
+
+    def tier_with_tables(self, state: Any, tables: Dict[str, Any]) -> Any:
+        """Rebuild the state pytree with (some) table states replaced."""
+        raise NotImplementedError
+
+    def tier_plan(self, batch: Dict[str, np.ndarray], rng: jax.Array):
+        """Host-side plan for one step: ``(ids, aug, remap_keys)`` where
+        ``ids[name]`` is every master row id the step will touch in that
+        table (hashing already applied), ``aug`` holds batch keys to
+        add/replace (e.g. pre-sampled negatives — the in-jit RNG derivation
+        replicated eagerly so the plan is exact, not a guess), and
+        ``remap_keys[name]`` lists the batch keys to remap into cache-slot
+        space."""
+        raise NotImplementedError
+
+    def tier_warm_rows(self) -> Optional[Dict[str, np.ndarray]]:
+        """Hottest-first master row ids per table for the pre-step-0 cache
+        prewarm (seeded from corpus frequency ranks); ``None`` to skip."""
+        return None
+
 
 class _Prefetcher:
     """Bounded background-thread batch prefetch (``queue_with_capacity``
@@ -248,7 +280,7 @@ class TrainLoop:
                     cursor={"step": step, "items": self._items_seen},
                     config_hash=self.config_hash,
                     keep=self.backup_keep, protect=self._restored_step,
-                    ledger=self.ledger,
+                    ledger=self.ledger, tier=self.tier,
                 )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
@@ -308,6 +340,20 @@ class TrainLoop:
             self.blackbox = None
             self._want_audit = False
         self._audit_report = None
+        # table_tier: host -> the tiered parameter store (tiered/): full-size
+        # masters in host RAM, fixed-budget HBM cache planes in the state
+        # pytree, per-step fault + id remap before dispatch. `device`
+        # (default) keeps today's resident tables and pays nothing.
+        table_tier = cfg.get_str("table_tier", "device")
+        if table_tier not in ("device", "host"):
+            raise ValueError(
+                f"table_tier must be device|host, got {table_tier!r}")
+        if table_tier == "host":
+            from swiftsnails_tpu.tiered import TierManager
+
+            self.tier = TierManager(trainer, registry=self.registry)
+        else:
+            self.tier = None
         # per-step dispatch cost trimming: the batch/replicated shardings are
         # mesh properties — build them ONCE instead of per step, and fold the
         # per-step RNG derivation into the jitted step itself (the step
@@ -374,8 +420,20 @@ class TrainLoop:
         root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
         total_items = 0
+        tier = self.tier
+        if tier is not None:
+            # full-size device planes -> host masters + HBM cache planes
+            # (prewarmed with the vocab's hottest rows); from here on `state`
+            # carries the small cache planes until master_state() at the end
+            state = tier.adopt(state)
         depth = trainer.config.get_int("prefetch_batches", 2)
-        batches = _Prefetcher(iter(trainer.batches()), depth=depth) if depth else trainer.batches()
+        src = iter(trainer.batches())
+        if tier is not None:
+            # stage upcoming steps' plans + missing master rows on the
+            # producer thread so the H2D fault traffic overlaps compute
+            depth = tier.prefetch_depth
+            src = tier.stage_stream(src, root_rng)
+        batches = _Prefetcher(src, depth=depth) if depth else src
         tel = self.tracer
         reg = self.registry
         bb = self.blackbox
@@ -404,6 +462,13 @@ class TrainLoop:
                     n_items = trainer.items_per_batch(batch)
                     self.profiler.on_step(step)
                     with step_annotation(trainer.name, step):
+                        if tier is not None:
+                            # fault the rows this step touches into the cache
+                            # and remap batch ids to slot space; runs BEFORE
+                            # any snapshot/injection so rollback targets a
+                            # slot-map-consistent state
+                            state, batch = tier.prepare(
+                                state, batch, root_rng, step)
                         dev_batch = self._device_batch(batch)
                         # fold_in happens inside the jitted step; the numpy
                         # scalar is an array operand (no per-value retrace)
@@ -443,6 +508,10 @@ class TrainLoop:
                     # so a concurrent profile_dir capture lines device work
                     # up with these host spans by step number
                     with tel.step_span(trainer.name, step):
+                        if tier is not None:
+                            with tel.span("tier-fault", step=step):
+                                state, batch = tier.prepare(
+                                    state, batch, root_rng, step)
                         with tel.span("h2d"):
                             dev_batch = self._device_batch(batch)
                         if self._want_audit and self._audit_report is None:
@@ -521,6 +590,11 @@ class TrainLoop:
                 "step": step,
                 "error": "run preempted; drained with a final checkpoint",
             })
+        if tier is not None:
+            # end-of-run write-back: flush every dirty cache slot and hand
+            # the caller the full-size master-backed state (same pytree type,
+            # shapes, dtypes as a resident run — export/eval are unchanged)
+            state = tier.master_state(state)
         host = {}
         if step % max(self.log_every, 1) != 0 or not self.log_every:
             host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
@@ -705,6 +779,8 @@ class TrainLoop:
                     record["guardrail"] = self.guardrail.summary()
                 if self.chaos is not None:
                     record["chaos"] = self.chaos.summary()
+                if self.tier is not None:
+                    record["tiered"] = self.tier.summary()
                 if self.preempted:
                     record["preempted"] = True
                 self.ledger.append(

@@ -111,6 +111,12 @@ class SparseCTRTrainer(Trainer):
                     tiles, self.capacity, g, model, g * model,
                 )
                 self.packed = False
+        # table_tier: host -> the tiered parameter store (tiered/): the
+        # hashed sparse table's full-size master lives in host RAM behind a
+        # fixed-budget HBM working-set cache, and batch rows arrive already
+        # hashed + remapped to cache-slot space (tier_plan). Only the table
+        # is tiered — the dense/opt pytrees are tiny and stay resident.
+        self.tiered = cfg.get_str("table_tier", "device") == "host"
         # comm_dtype: ICI payload compression for the mesh collectives
         # (f32 default = bit-identical; see parallel/comm.py, docs/SCALING.md)
         from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
@@ -276,7 +282,13 @@ class SparseCTRTrainer(Trainer):
         feats, labels = batch["feats"], batch["labels"]
         b, f = feats.shape
         mask = feats >= 0
-        rows = self._rows(feats).reshape(-1)
+        # tier mode: rows were hashed host-side and remapped to cache slots
+        # (padding fields hash to hash_row(0) on both paths and push only
+        # mask-zeroed gradients, so parity holds bit-for-bit)
+        if self.tiered:
+            rows = batch["rows"].reshape(-1)
+        else:
+            rows = self._rows(feats).reshape(-1)
         pulled = self._pull_rows(state.table, rows).reshape(b, f, self.table_dim)
 
         def loss_of(pulled, dense):
@@ -297,6 +309,35 @@ class SparseCTRTrainer(Trainer):
             dense, opt = state.dense, state.opt
         acc = ((logits > 0) == (labels > 0.5)).mean()
         return CTRState(table, dense, opt), {"loss": loss, "accuracy": acc}
+
+    # -- tiered parameter store (table_tier: host; see tiered/) -------------
+
+    def tier_spec(self):
+        if not self.tiered:
+            return None
+        if self.packed:
+            from swiftsnails_tpu.parallel.store import small_group
+
+            return {"table": {"layout": "packed_small",
+                              "group": small_group(self.table_dim)}}
+        return {"table": {"layout": "dense", "group": 1}}
+
+    def tier_tables(self, state: CTRState):
+        return {"table": state.table}
+
+    def tier_with_tables(self, state: CTRState, tables):
+        return CTRState(
+            table=tables.get("table", state.table),
+            dense=state.dense, opt=state.opt,
+        )
+
+    def tier_plan(self, batch, rng):
+        """Eager twin of the in-jit ``self._rows(feats)`` (same ``hash_row``,
+        deterministic eager-vs-traced). ``rng`` is unused — the CTR step has
+        no sampling."""
+        feats = jnp.asarray(np.asarray(batch["feats"]))
+        rows = np.asarray(hash_row(jnp.maximum(feats, 0), self.capacity))
+        return {"table": rows.ravel()}, {"rows": rows}, {"table": ["rows"]}
 
     # -- eval --------------------------------------------------------------
 

@@ -226,6 +226,19 @@ class Word2VecTrainer(Trainer):
                 "overlap: 1 requires fused: 1, grouped: 1 (the grouped "
                 "collective plane is the only overlap-scheduled path)")
 
+        # table_tier: host -> the tiered parameter store (tiered/): host-RAM
+        # master tables, HBM working-set cache, batch ids remapped to cache
+        # slots before dispatch. Supported on the dense and packed
+        # (pool/per_pair) substeps — the fused/grouped kernels address whole
+        # tables in VMEM and have no slot-space meaning. Negative sampling
+        # moves host-side (tier_plan replicates the in-jit RNG derivation
+        # bit-exactly), so the fault path knows every row before the step.
+        self.tiered = cfg.get_str("table_tier", "device") == "host"
+        if self.tiered and self.fused:
+            raise ValueError(
+                "table_tier: host does not compose with fused/grouped "
+                "kernels (they take whole-table VMEM references); use "
+                "packed: 1 with neg_mode pool/per_pair, or packed: 0")
         # stream: 1 = bounded-memory ingestion — the corpus is never
         # materialized; batches() re-opens a chunk stream each epoch
         # (scan_file_by_line parity; required for corpora larger than RAM).
@@ -321,6 +334,15 @@ class Word2VecTrainer(Trainer):
         if self.hash_keys:
             return hash_row(keys, self.capacity)
         return keys
+
+    def _step_rows(self, keys: jax.Array) -> jax.Array:
+        """In-substep id resolution. On the host tier the batch arrives
+        already hashed AND remapped to cache slots (tier_plan/TieredTable),
+        so the in-jit hash must not run again; export/eval paths keep
+        :meth:`_rows` against the full master table."""
+        if self.tiered:
+            return keys
+        return self._rows(keys)
 
     def _id_cat(self, *parts):
         """Concatenate row-id vectors; under a mesh, pin the result
@@ -529,13 +551,17 @@ class Word2VecTrainer(Trainer):
             pc -= 1
         return pc
 
-    def _substep_dense(self, state: W2VState, centers, contexts, rng, lr):
-        """Reference-faithful substep: per-pair negatives, 2-D tables."""
+    def _substep_dense(self, state: W2VState, centers, contexts, rng, lr,
+                       negs=None):
+        """Reference-faithful substep: per-pair negatives, 2-D tables.
+        ``negs`` (tier mode) carries host-pre-sampled, slot-remapped
+        negatives; the in-jit sampling below is skipped."""
         b = centers.shape[0]
         k = self.negatives
-        negs = alias_sample(self.neg_alias, rng, (b, k))
-        in_rows = self._rows(centers)
-        out_rows = self._rows(self._id_cat(contexts, negs.reshape(-1)))
+        if negs is None:
+            negs = alias_sample(self.neg_alias, rng, (b, k))
+        in_rows = self._step_rows(centers)
+        out_rows = self._step_rows(self._id_cat(contexts, negs.reshape(-1)))
 
         v = pull(state.in_table, in_rows)
         u = pull(state.out_table, out_rows)
@@ -548,7 +574,8 @@ class Word2VecTrainer(Trainer):
         out_table = push(state.out_table, out_rows, du, self.access, lr)
         return W2VState(in_table, out_table), loss, jnp.int32(0)
 
-    def _substep_packed(self, state: W2VState, centers, contexts, rng, lr):
+    def _substep_packed(self, state: W2VState, centers, contexts, rng, lr,
+                        negs=None):
         """Fast substep: packed tables, row-DMA pull/push, pooled negatives.
 
         Each block of ``pool_block`` consecutive pairs shares ``pool_size``
@@ -568,10 +595,10 @@ class Word2VecTrainer(Trainer):
         nb = b // pb
         pn = self.pool_size
         lam = self.negatives / pn
-        pools = alias_sample(self.neg_alias, rng, (nb, pn))
-        in_rows = self._rows(centers)
-        pos_rows = self._rows(contexts)
-        pool_rows = self._rows(pools.reshape(-1))
+        pools = alias_sample(self.neg_alias, rng, (nb, pn)) if negs is None else negs
+        in_rows = self._step_rows(centers)
+        pos_rows = self._step_rows(contexts)
+        pool_rows = self._step_rows(pools.reshape(-1))
         out_rows = self._id_cat(pos_rows, pool_rows)
 
         v = self._ppull(state.in_table, in_rows)
@@ -854,13 +881,15 @@ class Word2VecTrainer(Trainer):
             body, (state, pulled0), nxt)
         return state, losses, drops
 
-    def _substep_packed_perpair(self, state: W2VState, centers, contexts, rng, lr):
+    def _substep_packed_perpair(self, state: W2VState, centers, contexts,
+                                rng, lr, negs=None):
         """Packed tables with reference-faithful per-pair K negatives."""
         b = centers.shape[0]
         k = self.negatives
-        negs = alias_sample(self.neg_alias, rng, (b, k))
-        in_rows = self._rows(centers)
-        out_rows = self._rows(self._id_cat(contexts, negs.reshape(-1)))
+        if negs is None:
+            negs = alias_sample(self.neg_alias, rng, (b, k))
+        in_rows = self._step_rows(centers)
+        out_rows = self._step_rows(self._id_cat(contexts, negs.reshape(-1)))
 
         v = self._ppull(state.in_table, in_rows)
         u = self._ppull(state.out_table, out_rows)
@@ -927,8 +956,19 @@ class Word2VecTrainer(Trainer):
                 m["dedup_dropped"] = dropped
             return m
 
+        # table_tier: host — negatives were sampled host-side by tier_plan
+        # (bit-identical RNG derivation) and arrive in the batch already
+        # hashed and remapped to cache-slot space, like centers/contexts.
+        negs_all = batch.get("negs") if self.tiered else None
+
         if t == 1:
-            state, loss, dropped = substep(state, centers, contexts, rng, lr)
+            # only the tier-capable substeps accept negs=; the grouped-mesh
+            # and overlap paths (tiered rejects them) keep their signature
+            if negs_all is not None:
+                state, loss, dropped = substep(
+                    state, centers, contexts, rng, lr, negs=negs_all)
+            else:
+                state, loss, dropped = substep(state, centers, contexts, rng, lr)
             return state, metrics_of(loss, dropped)
 
         keys = jax.random.split(rng, t)
@@ -941,6 +981,19 @@ class Word2VecTrainer(Trainer):
             state, losses, drops = self._overlap_macro(state, c_t, x_t, keys, lr)
             return state, metrics_of(losses.mean(), drops.sum())
 
+        if negs_all is not None:
+            per = negs_all.shape[0] // t
+            n_t = negs_all.reshape((t, per) + negs_all.shape[1:])
+
+            def body(st, xs):
+                c, x, key, ng = xs
+                st, loss, dropped = substep(st, c, x, key, lr, negs=ng)
+                return st, (loss, dropped)
+
+            state, (losses, drops) = jax.lax.scan(
+                body, state, (c_t, x_t, keys, n_t))
+            return state, metrics_of(losses.mean(), drops.sum())
+
         def body(st, xs):
             c, x, key = xs
             st, loss, dropped = substep(st, c, x, key, lr)
@@ -948,6 +1001,78 @@ class Word2VecTrainer(Trainer):
 
         state, (losses, drops) = jax.lax.scan(body, state, (c_t, x_t, keys))
         return state, metrics_of(losses.mean(), drops.sum())
+
+    # -- tiered parameter store (table_tier: host; see tiered/) -------------
+
+    def _plan_rows(self, keys: np.ndarray) -> np.ndarray:
+        """Host-side twin of :meth:`_rows`: eager hash (same jit-able
+        ``hash_row``, threefry-free, deterministic eager-vs-traced) so the
+        tier planner sees the exact row ids the resident substep would."""
+        keys = np.asarray(keys)
+        if self.hash_keys:
+            return np.asarray(hash_row(jnp.asarray(keys), self.capacity))
+        return keys.astype(np.int32, copy=False)
+
+    def tier_spec(self):
+        if not self.tiered:
+            return None
+        layout = "packed" if self.packed else "dense"
+        return {
+            "in_table": {"layout": layout, "group": 1},
+            "out_table": {"layout": layout, "group": 1},
+        }
+
+    def tier_tables(self, state: W2VState):
+        return {"in_table": state.in_table, "out_table": state.out_table}
+
+    def tier_with_tables(self, state: W2VState, tables):
+        return W2VState(
+            in_table=tables.get("in_table", state.in_table),
+            out_table=tables.get("out_table", state.out_table),
+        )
+
+    def tier_plan(self, batch, rng):
+        """Host-side step plan: replicate the in-jit RNG derivation
+        (``split`` into per-substep keys, then ``alias_sample``) bit-exactly,
+        hash every id, and report which master rows the step touches.
+
+        Returns ``(ids, aug, remap_keys)``: per-table touched row ids, batch
+        augmentations (hashed centers/contexts + the pre-sampled negatives),
+        and which batch keys each table's remap applies to."""
+        centers = np.asarray(batch["centers"])
+        contexts = np.asarray(batch["contexts"])
+        n = centers.shape[0]
+        t = max(n // self.batch_size, 1)
+        b = n // t
+        if self.packed and self.neg_mode == "pool":
+            pb = min(self.pool_block, b)
+            while b % pb:
+                pb -= 1
+            shape = (b // pb, self.pool_size)
+        else:
+            shape = (b, self.negatives)
+        keys = [rng] if t == 1 else list(jax.random.split(rng, t))
+        negs = np.concatenate(
+            [np.asarray(alias_sample(self.neg_alias, key, shape))
+             for key in keys], axis=0)
+        c_r = self._plan_rows(centers)
+        x_r = self._plan_rows(contexts)
+        n_r = self._plan_rows(negs)
+        ids = {
+            "in_table": c_r.ravel(),
+            "out_table": np.concatenate([x_r.ravel(), n_r.ravel()]),
+        }
+        aug = {"centers": c_r, "contexts": x_r, "negs": n_r}
+        remap = {"in_table": ["centers"], "out_table": ["contexts", "negs"]}
+        return ids, aug, remap
+
+    def tier_warm_rows(self):
+        """Hottest-first row ids for the cache prewarm (vocab frequency
+        order; both tables share the unigram distribution)."""
+        order = np.argsort(
+            self.vocab.frequency_ranks(), kind="stable").astype(np.int64)
+        rows = np.asarray(self._plan_rows(order))
+        return {"in_table": rows, "out_table": rows}
 
     # -- export (ServerTerminate parity: text dump of the table) -----------
 

@@ -31,6 +31,8 @@ from __future__ import annotations
 import functools
 from typing import NamedTuple, Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
@@ -117,7 +119,13 @@ def pull(state: TableState, rows: jax.Array, access: Optional[AccessMethod] = No
     survey) in one fused op.
     """
     with jax.named_scope("ssn_pull"):
-        vals = state.table.at[rows].get(mode="promise_in_bounds")
+        if isinstance(state.table, np.ndarray):
+            # host master-backed state (table_tier: host, end of run): read
+            # straight from host RAM — the full table may not fit a device
+            vals = jnp.asarray(
+                np.take(state.table, np.asarray(rows), axis=0))
+        else:
+            vals = state.table.at[rows].get(mode="promise_in_bounds")
         if access is not None:
             vals = access.get_pull_value(vals)
         return vals
@@ -207,6 +215,33 @@ def push(
 def export_rows(state: TableState, rows: jax.Array) -> jax.Array:
     """Raw row read (no pull transform) — used by checkpoint/text export."""
     return state.table.at[rows].get(mode="fill", fill_value=0)
+
+
+# ---------------------------------------------------- tiered cache plane ---
+#
+# Host-tier support (swiftsnails_tpu/tiered): the HBM working-set cache is a
+# smaller table of the SAME layout, so pull/push above run verbatim in
+# cache-slot space — capacity and the invalid-row sentinel already derive
+# from table.shape[0]. The two jit'd movers below are the tier's fault/flush
+# data plane on a single device (the mesh twin is
+# transfer.scatter_slots_collective): an OOB-drop scatter that installs
+# faulted rows (pad index == shape[0] drops the update) and a fill-0 gather
+# for dirty-slot read-back. Callers bucket the index length (pow2) so the
+# trace cache stays logarithmic in fault-batch size.
+
+
+@jax.jit
+def scatter_rows(plane: jax.Array, idx: jax.Array, vals: jax.Array) -> jax.Array:
+    """Install rows into a cache plane: ``plane[idx] = vals`` with
+    out-of-range indices dropped (the fault path's padding sentinel)."""
+    return plane.at[idx].set(vals.astype(plane.dtype), mode="drop")
+
+
+@jax.jit
+def gather_rows(plane: jax.Array, idx: jax.Array) -> jax.Array:
+    """Read rows back from a cache plane (dirty-slot flush); out-of-range
+    padding reads zeros and is sliced off by the caller."""
+    return plane.at[idx].get(mode="fill", fill_value=0)
 
 
 # ------------------------------------------------ small-row packed plane ---

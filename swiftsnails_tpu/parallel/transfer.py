@@ -678,3 +678,74 @@ def push_collective_packed_bucketed(
         table, slots, dropped = fn(state.table, dict(state.slots), rows, grads,
                                    *extra)
     return PackedTableState(table=table, slots=slots), dropped
+
+
+# ---------------------------------------------------- tiered cache plane ---
+#
+# Slot-indexed twins for the host tier (swiftsnails_tpu/tiered): under a
+# mesh the HBM working-set cache is a row-sharded plane like any other
+# table, and because capacity and the invalid-row sentinel derive from
+# table.shape[0], the pull/push collectives above already operate correctly
+# in cache-slot space. The named wrappers pin that contract; the scatter
+# below is the genuinely new mover — the batched host->device fault path
+# installing gathered master rows shard-local (no resharding round trip).
+
+
+def pull_collective_slots(mesh: Mesh, cache_state, slots: jax.Array,
+                          comm_dtype: str = "float32") -> jax.Array:
+    """Slot-indexed pull over a tiered cache plane.
+
+    Identical protocol to :func:`pull_collective`; ``slots`` are cache-slot
+    ids produced by the host-side remap (``tiered.TieredTable.remap``), and
+    the per-shard row count derives from the CACHE capacity, so no resident
+    assumptions leak in. The packed twins dispatch the same way — a cache
+    plane is indistinguishable from a small table.
+    """
+    return pull_collective(mesh, cache_state, slots, comm_dtype=comm_dtype)
+
+
+def push_collective_slots(
+    mesh: Mesh, cache_state, slots: jax.Array, grads: jax.Array,
+    access: AccessMethod, lr, comm_dtype: str = "float32", seed=None,
+):
+    """Slot-indexed push over a tiered cache plane (see
+    :func:`pull_collective_slots`); the invalid-row sentinel is the cache
+    budget, so padded/dropped slots behave exactly as on the resident path."""
+    return push_collective(mesh, cache_state, slots, grads, access, lr,
+                           comm_dtype=comm_dtype, seed=seed)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def scatter_slots_collective(mesh: Mesh, plane: jax.Array, slot_ids,
+                             values) -> jax.Array:
+    """Install faulted rows into a row-sharded cache plane, shard-local.
+
+    ``slot_ids``/``values`` are replicated (the fault batch is tiny relative
+    to the plane); each model shard keeps only its owned slice via an
+    OOB-drop scatter, so the plane's sharding is preserved and no
+    cross-shard traffic moves table bytes twice. Out-of-range ids
+    (``plane.shape[0]`` padding) are dropped everywhere.
+    """
+    from swiftsnails_tpu.parallel.mesh import MODEL_AXIS as _M
+
+    model = mesh.shape[_M]
+    if plane.shape[0] % model:
+        raise ValueError(
+            f"cache budget {plane.shape[0]} not divisible by model axis {model}")
+    per = plane.shape[0] // model
+    spec = P(_M, *([None] * (plane.ndim - 1)))
+
+    def body(shard, ids, vals):
+        m = lax.axis_index(_M)
+        local = ids - m * per
+        local = jnp.where((local >= 0) & (local < per), local, per)
+        return shard.at[local].set(vals.astype(shard.dtype), mode="drop")
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, P(), P()),
+        out_specs=spec,
+        check_vma=False,
+    )
+    with jax.named_scope("ssn_tier_fault_scatter"):
+        return fn(plane, jnp.asarray(slot_ids), jnp.asarray(values))

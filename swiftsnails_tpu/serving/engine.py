@@ -264,6 +264,7 @@ class Servant:
         topk: int = DEFAULT_TOPK,
         topk_tile_rows: int = 4096,
         default_table: Optional[str] = None,
+        tier_hbm_budget_mb: float = 0.0,
     ):
         if not tables:
             raise ValueError("Servant needs at least one table")
@@ -276,7 +277,20 @@ class Servant:
         self.manifest = manifest or {}
         self.step = int(self.manifest.get("step", 0) or 0)
         self.version = 0  # bumped by every reload; keys the hot-row cache
-        self._tables = {k: jnp.asarray(v) for k, v in tables.items()}
+        # table_tier: host (tier_hbm_budget_mb > 0): the full normalized
+        # tables stay in host RAM and the device holds fixed-budget read
+        # caches — cold rows fault in batched behind the hot-row LRU
+        # (serving vocabularies bigger than device memory). 0 = resident.
+        self.tier: Dict[str, Any] = {}
+        self._tier_cache: Dict[str, Any] = {}
+        self._tier_lock = threading.Lock()
+        self.tier_budget_mb = float(tier_hbm_budget_mb)
+        self._tier_stats = None
+        if self.tier_budget_mb > 0:
+            self._tables = {k: np.asarray(v) for k, v in tables.items()}
+            self._build_tier()
+        else:
+            self._tables = {k: jnp.asarray(v) for k, v in tables.items()}
         self._dense = dense if dense is not None else {}
         self.default_table = default_table or (
             "in_table" if "in_table" in self._tables else
@@ -318,6 +332,74 @@ class Servant:
                 linger_s=linger_s, on_shed=self._note_shed,
             ),
         }
+
+    # -- tiered read path (table_tier: host; see tiered/) -------------------
+
+    def _build_tier(self) -> None:
+        """Wrap each host master in a read-only :class:`TieredTable` with a
+        prewarmed device cache. Vocab ids are frequency-ranked (the training
+        ordering contract), so the id head IS the zipf head — prewarm it."""
+        from swiftsnails_tpu.parallel.store import TableState
+        from swiftsnails_tpu.tiered.store import (
+            HostMaster, TieredTable, TierStats,
+        )
+
+        if self._tier_stats is None:
+            self._tier_stats = TierStats()
+        budget_each = self.tier_budget_mb / max(len(self._tables), 1)
+        self.tier = {}
+        self._tier_cache = {}
+        for name, arr in self._tables.items():
+            master = HostMaster(TableState(table=arr, slots={}), "dense")
+            units = int(budget_each * (1 << 20) // max(master.unit_nbytes, 1))
+            tt = TieredTable(
+                master, units, mesh=self.mesh, name=name,
+                stats=self._tier_stats, read_only=True,
+            )
+            cache = tt.make_cache()
+            cache = tt.prewarm(
+                cache, np.arange(min(tt.budget, master.units), dtype=np.int64))
+            self.tier[name] = tt
+            self._tier_cache[name] = cache
+
+    def _tier_pull(self, name: str, ids: np.ndarray) -> np.ndarray:
+        """Cold-row fault: make ``ids`` resident in the cache plane, remap to
+        slots, gather from the cache. The lock serializes fault + remap +
+        gather across the kernel batcher threads — a concurrent eviction must
+        never overwrite a slot between the remap and its device read."""
+        tt = self.tier[name]
+        with self._tier_lock:
+            cache = tt.ensure(self._tier_cache[name], np.asarray(ids))
+            self._tier_cache[name] = cache
+            slots = tt.remap(np.asarray(ids, np.int64))
+            return np.asarray(
+                self._pull_fn(cache.table, jnp.asarray(slots, jnp.int32)))
+
+    def _topk_master(self, name: str, queries: np.ndarray, k: int,
+                     normalize: bool):
+        """Over-budget topk: stream the host master through the device one
+        ``topk_tile_rows`` tile at a time with a running best-k merge — the
+        full table never resides in HBM. Scores are per-row (cosine or raw
+        dot), so chunk results merge exactly."""
+        master = self.tier[name].master.table
+        tile = max(int(self.topk_tile_rows), 1)
+        q = np.asarray(queries, np.float32)
+        parts_s: List[np.ndarray] = []
+        parts_i: List[np.ndarray] = []
+        for lo in range(0, master.shape[0], tile):
+            chunk = master[lo : lo + tile]
+            s, i = topk_tiled(
+                jnp.asarray(chunk), jnp.asarray(q),
+                k=min(k, chunk.shape[0]), tile_rows=tile,
+                normalize=normalize,
+            )
+            parts_s.append(np.asarray(s))
+            parts_i.append(np.asarray(i) + lo)
+        s = np.concatenate(parts_s, axis=1)
+        i = np.concatenate(parts_i, axis=1)
+        order = np.argsort(-s, axis=1, kind="stable")[:, :k]
+        rows = np.arange(s.shape[0])[:, None]
+        return s[rows, order], i[rows, order]
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -386,6 +468,10 @@ class Servant:
                           config.get_int("serve_queue_depth", DEFAULT_QUEUE_DEPTH))
         kwargs.setdefault("topk", config.get_int("serve_topk", DEFAULT_TOPK))
         kwargs.setdefault("comm_dtype", config.get_str("comm_dtype", "float32"))
+        if config.get_str("table_tier", "device") == "host":
+            kwargs.setdefault(
+                "tier_hbm_budget_mb",
+                config.get_float("tier_hbm_budget_mb", 64.0))
         return cls(
             tables, manifest=manifest, mesh=mesh, scorer=scorer, dense=dense,
             default_table=default_table, **kwargs,
@@ -396,7 +482,15 @@ class Servant:
         """Swap in new tables; bumps the version so every cached row of the
         old tables misses (stale rows can never be served)."""
         with self._lock:
-            self._tables = {k: jnp.asarray(v) for k, v in tables.items()}
+            if self.tier_budget_mb > 0:
+                # new masters + fresh caches/slot maps: a stale slot mapping
+                # against the old tables must never serve again (the version
+                # bump below already invalidates the hot-row LRU)
+                self._tables = {k: np.asarray(v) for k, v in tables.items()}
+                with self._tier_lock:
+                    self._build_tier()
+            else:
+                self._tables = {k: jnp.asarray(v) for k, v in tables.items()}
             if dense is not None:
                 self._dense = dense
             if manifest is not None:
@@ -519,7 +613,10 @@ class Servant:
             padded = np.concatenate(
                 [chunk, np.full(pad, PAD_ROW, np.int32)]
             ) if pad else chunk
-            vals = np.asarray(self._pull_fn(table, jnp.asarray(padded)))
+            if name in self.tier:
+                vals = self._tier_pull(name, padded)
+            else:
+                vals = np.asarray(self._pull_fn(table, jnp.asarray(padded)))
             out.append(vals[: len(chunk)])
             self.registry.counter("serve.pull.rows").inc(len(chunk))
             self.registry.counter("serve.pull.pad_rows").inc(pad)
@@ -546,10 +643,15 @@ class Servant:
                 padded = np.concatenate(
                     [chunk, np.zeros((pad, chunk.shape[1]), np.float32)]
                 ) if pad else chunk
-                s, i = topk_tiled(
-                    table, jnp.asarray(padded), k=k,
-                    tile_rows=self.topk_tile_rows, normalize=normalize,
-                )
+                if name in self.tier:
+                    # exhaustive scans never fault the cache: stream the host
+                    # master through the device in tiles instead
+                    s, i = self._topk_master(name, padded, k, normalize)
+                else:
+                    s, i = topk_tiled(
+                        table, jnp.asarray(padded), k=k,
+                        tile_rows=self.topk_tile_rows, normalize=normalize,
+                    )
                 all_s.append(np.asarray(s)[: len(chunk)])
                 all_i.append(np.asarray(i)[: len(chunk)])
                 self.registry.counter("serve.topk.queries").inc(len(chunk))
@@ -572,6 +674,20 @@ class Servant:
         logits = self.scorer.forward(pulled, dense, mask)
         return jax.nn.sigmoid(logits)
 
+    def _score_tiered(self, feats: np.ndarray) -> np.ndarray:
+        """Score through the cache tier: hash the fields eagerly, fault the
+        rows via the shared pull path, then run the forward pass on the
+        gathered embeddings (padding fields hash like real rows but their
+        gathered values are mask-zeroed by ``forward``)."""
+        b, f = feats.shape
+        feats_j = jnp.asarray(feats)
+        rows = np.asarray(self.scorer._rows(feats_j)).reshape(-1)
+        pulled = self._tier_pull(self.default_table, rows).reshape(
+            b, f, self.scorer.table_dim)
+        logits = self.scorer.forward(
+            jnp.asarray(pulled), self._dense, feats_j >= 0)
+        return np.asarray(jax.nn.sigmoid(logits))
+
     def _dispatch_score(self, batch: List[_Request]) -> None:
         table = self._tables[self.default_table]
         feats = np.concatenate([r.payload["feats"] for r in batch])
@@ -584,9 +700,12 @@ class Servant:
             padded = np.concatenate(
                 [chunk, np.full((pad, chunk.shape[1]), PAD_FIELD, np.int32)]
             ) if pad else chunk
-            scores = np.asarray(
-                self._score_fn(table, self._dense, jnp.asarray(padded))
-            )
+            if self.default_table in self.tier:
+                scores = self._score_tiered(padded)
+            else:
+                scores = np.asarray(
+                    self._score_fn(table, self._dense, jnp.asarray(padded))
+                )
             outs.append(scores[: len(chunk)])
             self.registry.counter("serve.score.rows").inc(len(chunk))
             self.registry.counter("serve.score.pad_rows").inc(pad)
@@ -672,6 +791,14 @@ class Servant:
                 k: int(reg.counter(f"serve.{k}.pad_rows").value)
                 for k in ("pull", "topk", "score")
             },
+            **({"tiered": {
+                **self._tier_stats.as_dict(),
+                "tables": {
+                    name: {"budget_slots": tt.budget,
+                           "master_units": tt.master.units}
+                    for name, tt in self.tier.items()
+                },
+            }} if self.tier else {}),
         }
 
 

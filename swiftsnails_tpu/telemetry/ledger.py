@@ -390,6 +390,38 @@ def render_report(ledger: Ledger) -> str:
                     )
                 )
 
+    # tiered parameter store: run records carry a `tiered` summary when
+    # table_tier: host was on; bench records carry the `tiered` lane block
+    tiered_rows = []
+    for r in runs:
+        t = r.get("tiered")
+        if isinstance(t, dict):
+            tiered_rows.append((r.get("ts", "?"), "run  ", t))
+    for r in ledger.records("bench"):
+        p = r.get("payload") if isinstance(r.get("payload"), dict) else {}
+        t = (p or {}).get("tiered")
+        if isinstance(t, dict):
+            tiered_rows.append((r.get("ts", "?"), "bench", t))
+    if tiered_rows:
+        lines.append("")
+        lines.append("tiered parameter store (newest last):")
+        for ts, kind, t in tiered_rows[-5:]:
+            cache = t.get("cache") if isinstance(t.get("cache"), dict) else t
+            lines.append(
+                f"  {ts}  {kind}  hit_rate={cache.get('hit_rate')}  "
+                f"faulted_rows={cache.get('faulted_rows')}  "
+                f"evictions={cache.get('evictions')}  "
+                f"h2d={_fmt_num(cache.get('h2d_bytes', 0))}B  "
+                f"d2h={_fmt_num(cache.get('d2h_bytes', 0))}B"
+            )
+            if kind == "bench":
+                lines.append(
+                    f"    lane: {_fmt_num(t.get('words_per_sec', 0))} words/s "
+                    f"({t.get('tiered_over_resident')}x resident)  "
+                    f"parity={t.get('parity_bit_identical')}  "
+                    f"over_budget_round_trip={t.get('round_trip_ok')}"
+                )
+
     outages = ledger.records("outage")
     if outages:
         lines.append("")
@@ -527,7 +559,10 @@ def check_regression(
         v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
         if v_msg:
             msg = f"{msg}\n{v_msg}"
-        return max(2, c_rc, v_rc), msg
+        t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
+        if t_msg:
+            msg = f"{msg}\n{t_msg}"
+        return max(2, c_rc, v_rc, t_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -543,7 +578,10 @@ def check_regression(
             v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
             if v_msg:
                 msg = f"{msg}\n{v_msg}"
-            return max(0, c_rc, v_rc), msg
+            t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
+            if t_msg:
+                msg = f"{msg}\n{t_msg}"
+            return max(0, c_rc, v_rc, t_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -566,7 +604,10 @@ def check_regression(
     v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
     if v_msg:
         msg = f"{msg}\n{v_msg}"
-    return max(rc, s_rc, c_rc, v_rc), msg
+    t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
+    if t_msg:
+        msg = f"{msg}\n{t_msg}"
+    return max(rc, s_rc, c_rc, v_rc, t_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -713,6 +754,64 @@ def _check_serving_regression(
     return 0, (
         f"serving ok: pull {qps:,.1f} qps / p99 {p99}ms vs "
         f"qps baseline {base_qps:,.1f} ({platform or '?'})"
+    )
+
+
+def _tiered_values(record: Dict) -> Optional[Tuple[float, bool]]:
+    """(words_per_sec, parity_ok) from a bench payload's ``tiered`` block, or
+    None when the tiered lane didn't run in that record. ``parity_ok``
+    collapses the lane's correctness flags: equal-vocab bit-parity AND the
+    over-budget train->checkpoint->serve round trip."""
+    t = record.get("payload", {}).get("tiered")
+    if not isinstance(t, dict):
+        return None
+    wps = t.get("words_per_sec")
+    if not (isinstance(wps, (int, float)) and wps > 0):
+        return None
+    parity = bool(t.get("parity_bit_identical")) and bool(t.get("round_trip_ok"))
+    return float(wps), parity
+
+
+def _check_tiered_regression(
+    ledger: Ledger, max_drop_pct: float
+) -> Tuple[int, Optional[str]]:
+    """Gate the tiered lane: the newest bench record carrying a ``tiered``
+    block must hold bit-parity + the over-budget round trip (correctness —
+    gated on ANY platform, like chaos recovery) and its words/sec floor
+    against the best earlier record of the same platform. No tiered history
+    gates nothing."""
+    with_tiered = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict) and _tiered_values(r)
+    ]
+    if not with_tiered:
+        return 0, None
+    newest_rec = with_tiered[-1]
+    wps, parity = _tiered_values(newest_rec)
+    if not parity:
+        return 1, (
+            "tiered REGRESSION: newest lane record failed bit-parity or the "
+            "over-budget round trip (correctness gate)")
+    platform = newest_rec["payload"].get("platform")
+    same = [r for r in with_tiered
+            if r["payload"].get("platform") == platform]
+    earlier = [_tiered_values(r)[0] for r in same[:-1]]
+    if not earlier:
+        return 0, (
+            f"tiered: single {platform or '?'} record ({wps:,.1f} words/s, "
+            "parity ok); nothing to compare against"
+        )
+    base = max(earlier)
+    floor = base * (1.0 - max_drop_pct / 100.0)
+    if wps < floor:
+        return 1, (
+            f"tiered REGRESSION: {wps:,.1f} words/s is "
+            f"{(1 - wps / base) * 100:.1f}% below baseline {base:,.1f} "
+            f"(allowed {max_drop_pct:.1f}%)"
+        )
+    return 0, (
+        f"tiered ok: {wps:,.1f} words/s vs baseline {base:,.1f} "
+        f"({(wps / base - 1) * 100:+.1f}%), parity ok ({platform or '?'})"
     )
 
 

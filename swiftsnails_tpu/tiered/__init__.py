@@ -1,0 +1,10 @@
+"""Tiered parameter store: host-RAM masters + HBM working-set cache.
+
+Enabled with ``table_tier: host`` (default ``device`` keeps today's fully
+HBM-resident tables with zero hot-path cost). See ``docs/TIERED.md``.
+"""
+
+from swiftsnails_tpu.tiered.manager import TierManager
+from swiftsnails_tpu.tiered.store import HostMaster, TieredTable, TierStats
+
+__all__ = ["TierManager", "TieredTable", "HostMaster", "TierStats"]

@@ -1,0 +1,204 @@
+"""TierManager — wires the tiered store into the training loop.
+
+Lifecycle (all outside jit, all on the host side of the step boundary):
+
+* :meth:`adopt`       — move the trainer's freshly-initialized (or restored)
+  device planes to host masters, build one :class:`TieredTable` per table
+  within the ``tier_hbm_budget_mb`` budget, pre-warm with the vocab's hottest
+  rows, and hand back a state whose table leaves are the small cache planes;
+* :meth:`stage_stream` — generator wrapped around ``trainer.batches()``
+  *before* the ``_Prefetcher``, so the producer thread plans each upcoming
+  batch (ids + host-replicated negative sampling), gathers the predicted
+  missing rows from the masters, and ships them to the device — H2D overlaps
+  the current step's compute (double-buffered via ``tier_prefetch_depth``);
+* :meth:`prepare`     — per step, on the consumer side: fault every unit the
+  batch touches (consuming the staged payload), remap batch ids into
+  cache-slot space, return the updated state + batch;
+* :meth:`master_state` — flush dirty slots and return the full-size
+  master-backed state (checkpoint save, end of run).
+
+Determinism: the stage/prepare planners replicate the in-jit RNG derivation
+exactly (``fold_in(root_rng, step)`` then ``alias_sample`` — threefry is
+deterministic eager-vs-traced), so the host knows the step's negative rows
+ahead of time and the tiered run stays bit-identical to the resident one.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+import jax
+
+from swiftsnails_tpu.tiered.store import HostMaster, TieredTable, TierStats
+from swiftsnails_tpu.utils.config import ConfigError
+
+
+class TierManager:
+    def __init__(self, trainer, registry=None):
+        spec = trainer.tier_spec()
+        if spec is None:
+            raise ConfigError(
+                f"table_tier: host is not supported by trainer "
+                f"'{trainer.name}' (no tier_spec)")
+        self.trainer = trainer
+        self.spec = spec
+        cfg = trainer.config
+        self.budget_mb = cfg.get_float("tier_hbm_budget_mb", 64.0)
+        if self.budget_mb <= 0:
+            raise ConfigError("tier_hbm_budget_mb must be > 0")
+        self.prefetch_depth = cfg.get_int("tier_prefetch_depth", 2)
+        self.registry = registry
+        self.stats = TierStats()
+        self.tables: Dict[str, TieredTable] = {}
+        self._published: Dict[str, int] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def adopt(self, state):
+        """Device planes -> host masters + device cache planes (+ prewarm)."""
+        tabs = self.trainer.tier_tables(state)
+        budget_each = self.budget_mb / max(len(tabs), 1)
+        caches = {}
+        for name, st in tabs.items():
+            info = self.spec[name]
+            master = HostMaster(
+                st, info["layout"], group=int(info.get("group", 1)))
+            units = int(budget_each * (1 << 20) // max(master.unit_nbytes, 1))
+            tt = TieredTable(
+                master, units, mesh=self.trainer.mesh, name=name,
+                stats=self.stats,
+            )
+            self.tables[name] = tt
+            caches[name] = tt.make_cache()
+        warm = self.trainer.tier_warm_rows()
+        if warm:
+            for name, rows in warm.items():
+                tt = self.tables.get(name)
+                if tt is None or rows is None or not len(rows):
+                    continue
+                caches[name] = tt.prewarm(
+                    caches[name], tt.units_for(np.asarray(rows)))
+        self._publish()
+        return self.trainer.tier_with_tables(state, caches)
+
+    # -- per-step fault + remap ----------------------------------------------
+
+    def _plan(self, batch, root_rng, step: int):
+        rng = jax.random.fold_in(root_rng, np.uint32(step))
+        return self.trainer.tier_plan(batch, rng)
+
+    def prepare(self, state, batch, root_rng, step: int):
+        """Fault + remap for one step; returns ``(state, batch)`` with the
+        cache planes updated and every table id in cache-slot space."""
+        staged = batch.pop("_tier_staged", None) if "_tier_staged" in batch else None
+        if staged is not None and staged.get("step") != step:
+            staged = None  # stale hint (e.g. resume: 1 offsets the stream)
+        if staged is not None:
+            ids, aug, remap_keys = staged["plan"]
+        else:
+            ids, aug, remap_keys = self._plan(batch, root_rng, step)
+        tabs = self.trainer.tier_tables(state)
+        out_batch = {k: v for k, v in batch.items() if k != "_tier_staged"}
+        out_batch.update(aug)
+        new_tabs = {}
+        for name, tt in self.tables.items():
+            payload = staged["payload"].get(name) if staged else None
+            st = tt.ensure(
+                tabs[name], tt.units_for(ids[name]), staged=payload)
+            new_tabs[name] = st
+            for key in remap_keys.get(name, ()):
+                out_batch[key] = tt.remap(out_batch[key])
+        self._publish()
+        return self.trainer.tier_with_tables(state, new_tabs), out_batch
+
+    # -- prefetch staging -----------------------------------------------------
+
+    def stage_stream(self, src: Iterator, root_rng) -> Iterator:
+        """Wrap the batch stream so each batch carries a ``_tier_staged``
+        payload: the plan plus the predicted-missing master rows already on
+        device. Runs on the ``_Prefetcher`` producer thread, so the gather +
+        H2D overlap device compute. The residency peek may be stale (the
+        consumer mutates the slot map concurrently) — that only costs
+        efficiency, never correctness: :meth:`prepare` re-checks residency
+        and host-gathers anything the stage missed."""
+
+        def gen():
+            for i, b in enumerate(src):
+                b = dict(b)
+                b["_tier_staged"] = self._stage(b, root_rng, i)
+                yield b
+
+        return gen()
+
+    def _stage(self, batch, root_rng, step: int):
+        plan = self._plan(batch, root_rng, step)
+        ids, _, _ = plan
+        payload = {}
+        for name, tt in self.tables.items():
+            missing = tt.peek_missing(tt.units_for(ids[name]))
+            if not missing.size:
+                continue
+            # version snapshot BEFORE the gather: a write-back racing the
+            # gather bumps the generation, so the install sees the mismatch
+            # and discards the (possibly torn) staged row
+            vers = tt.master_ver[missing].copy()
+            t_rows, s_rows = tt.master.gather(missing)
+            self.stats.h2d_bytes += t_rows.nbytes + sum(
+                v.nbytes for v in s_rows.values())
+            dev_t = self._to_device(t_rows)
+            dev_s = {k: self._to_device(v) for k, v in s_rows.items()}
+            payload[name] = (missing, vers, dev_t, dev_s)
+        return {"step": step, "plan": plan, "payload": payload}
+
+    def _to_device(self, arr: np.ndarray):
+        import jax.numpy as jnp
+
+        if self.trainer.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                arr, NamedSharding(self.trainer.mesh, PartitionSpec()))
+        return jnp.asarray(arr)
+
+    # -- write-back / reporting -----------------------------------------------
+
+    def master_state(self, state):
+        """Flush every dirty slot, then return the full-size master-backed
+        state (same pytree type/shapes/dtypes; NumPy leaves). The flush
+        happens *before* the caller builds any checkpoint manifest."""
+        tabs = self.trainer.tier_tables(state)
+        for name, tt in self.tables.items():
+            tt.flush(tabs[name])
+        masters = {name: tt.master.state() for name, tt in self.tables.items()}
+        return self.trainer.tier_with_tables(state, masters)
+
+    def summary(self) -> Dict:
+        out = self.stats.as_dict()
+        out["tables"] = {
+            name: {
+                "budget_slots": tt.budget,
+                "master_units": tt.master.units,
+                "unit_bytes": tt.master.unit_nbytes,
+                "resident": int((tt.unit_of >= 0).sum()),
+                "dirty": int(tt.dirty.sum()),
+            }
+            for name, tt in self.tables.items()
+        }
+        return out
+
+    def _publish(self) -> None:
+        """Mirror the shared counters into the telemetry registry (deltas —
+        registry counters are inc-only)."""
+        reg = self.registry
+        if reg is None:
+            return
+        reg.gauge("tier_cache_hit_rate").set(self.stats.hit_rate)
+        for key in ("h2d_bytes", "d2h_bytes", "faults", "faulted_rows",
+                    "evictions", "flushed_rows"):
+            cur = getattr(self.stats, key)
+            delta = cur - self._published.get(key, 0)
+            if delta:
+                reg.counter(f"tier_{key}").inc(delta)
+                self._published[key] = cur

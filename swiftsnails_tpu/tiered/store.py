@@ -1,0 +1,427 @@
+"""Host-RAM master tables with an HBM working-set cache.
+
+The resident store (``parallel/store.py``) caps table size at device memory.
+This module adds the missing tier from the reference's design space: the
+full-size **master** planes live in host RAM as NumPy arrays (same leaves and
+layouts as the device state — dense 2-D ``[C, dim]``, word2vec packed
+``[C, S, 128]``, CTR packed-small ``[T, S, 128]``), and the device holds only
+a fixed-budget **cache** plane plus a host-side slot map.
+
+The central trick: the cache plane is *just a smaller table of the same
+layout*. Every pull/push function and collective derives its capacity and
+invalid-row sentinel from ``table.shape[0]``, so once batch ids are remapped
+host-side from master units to cache slots, the entire existing data plane —
+``pull``/``push``, the packed kernels, the shard_map collectives — runs
+verbatim in slot space. Bit-parity with the resident store at f32 follows
+because the remap is injective (duplicate-group structure and within-group
+order are preserved through ``merge_duplicate_rows``'s stable sort, and XLA
+scatter applies duplicate updates in update order, not index order).
+
+Write-back invariant: a cache slot is the unique authoritative copy of its
+unit from fault until flush. Dirty slots are flushed device->host on
+eviction, on checkpoint (before the manifest is built), and at end of run —
+never dropped — so ``master ∪ dirty-cache`` always equals the resident
+table's content exactly.
+
+Eviction is frequency-based CLOCK: each slot carries a saturating reference
+counter bumped on every hit (and seeded by the vocab-frequency prewarm); the
+clock hand halves counters as it sweeps, so hot rows survive many passes and
+cold rows age out in O(log ref) sweeps. Slots touched by the current batch
+are pinned for the duration of the fault.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class TierStats:
+    """Shared counters for the telemetry surface (goodput block, ledger run
+    record, bench ``tiered`` lane). ``lookups``/``hits`` count unique units
+    per fault batch; ``faulted_rows``/``evictions`` count cache units (rows
+    for the dense/packed layouts, tiles for packed-small)."""
+
+    lookups: int = 0
+    hits: int = 0
+    faults: int = 0  # batched fault events (one per table per faulting step)
+    faulted_rows: int = 0  # units moved host -> device
+    evictions: int = 0
+    flushes: int = 0  # batched write-back events
+    flushed_rows: int = 0  # dirty units written device -> host
+    h2d_bytes: int = 0
+    d2h_bytes: int = 0
+    prewarmed_rows: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def as_dict(self) -> Dict:
+        return {
+            "hit_rate": round(self.hit_rate, 4),
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "faults": self.faults,
+            "faulted_rows": self.faulted_rows,
+            "evictions": self.evictions,
+            "flushes": self.flushes,
+            "flushed_rows": self.flushed_rows,
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "prewarmed_rows": self.prewarmed_rows,
+        }
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class HostMaster:
+    """NumPy master plane for one table: the same (table, slots) leaves as
+    the device state, full size, host-resident. ``group`` is the number of
+    logical rows per cache unit (1 except the packed-small plane, where one
+    unit is a ``[S, 128]`` tile holding G rows)."""
+
+    def __init__(self, state, layout: str, group: int = 1):
+        self.kind = type(state)  # TableState | PackedTableState
+        self.layout = layout
+        self.group = int(group)
+        # owned, writable copies: device_get hands back views onto read-only
+        # buffers, and the masters are mutated in place by every write-back
+        self.table = np.array(jax.device_get(state.table))
+        self.slots = {
+            k: np.array(jax.device_get(v)) for k, v in state.slots.items()
+        }
+
+    @property
+    def units(self) -> int:
+        return self.table.shape[0]
+
+    @property
+    def unit_nbytes(self) -> int:
+        per = int(np.prod(self.table.shape[1:], dtype=np.int64)) or 1
+        n = per * self.table.dtype.itemsize
+        for v in self.slots.values():
+            sper = int(np.prod(v.shape[1:], dtype=np.int64)) or 1
+            n += sper * v.dtype.itemsize
+        return n
+
+    def gather(self, units: np.ndarray) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        return self.table[units], {k: v[units] for k, v in self.slots.items()}
+
+    def scatter(self, units: np.ndarray, table_rows: np.ndarray,
+                slot_rows: Dict[str, np.ndarray]) -> None:
+        self.table[units] = table_rows
+        for k, v in slot_rows.items():
+            self.slots[k][units] = v
+
+    def state(self):
+        """The full-size state pytree (NumPy leaves) — what checkpoints save
+        and what the trainer gets back at end of run. Same NamedTuple type,
+        shapes, and dtypes as the resident device state, so the on-disk
+        checkpoint format is unchanged."""
+        return self.kind(table=self.table, slots=dict(self.slots))
+
+
+class TieredTable:
+    """Fixed-budget HBM cache + slot map over one :class:`HostMaster`.
+
+    Holds *no* device arrays: the cache plane flows through the trainer's
+    state pytree (so jit donation stays safe), and every method that moves
+    data takes the current cache state and returns the updated one.
+    """
+
+    def __init__(
+        self,
+        master: HostMaster,
+        budget_units: int,
+        *,
+        mesh=None,
+        name: str = "",
+        stats: Optional[TierStats] = None,
+        read_only: bool = False,
+    ):
+        self.master = master
+        self.mesh = mesh
+        self.name = name or "table"
+        self.stats = stats if stats is not None else TierStats()
+        self.read_only = read_only
+        budget = max(int(budget_units), 1)
+        if mesh is not None:
+            from swiftsnails_tpu.parallel.mesh import MODEL_AXIS
+
+            model = mesh.shape[MODEL_AXIS]
+            budget = -(-budget // model) * model  # rows-per-shard divisibility
+        self.budget = min(budget, master.units)
+        self.group = master.group
+        # host slot map: unit -> cache slot (and inverse), CLOCK state
+        self.slot_of = np.full(master.units, -1, np.int64)
+        self.unit_of = np.full(self.budget, -1, np.int64)
+        self.ref = np.zeros(self.budget, np.uint8)  # saturating frequency
+        self.dirty = np.zeros(self.budget, bool)
+        self.hand = 0
+        self.used = 0  # slots handed out before the clock ever has to evict
+        # per-unit write-back generation: bumped after every master write, so
+        # a staged (prefetched) row whose unit was fault->update->evict-flushed
+        # between stage and install is detected as stale and re-gathered —
+        # installing it would silently resurrect the pre-update value
+        self.master_ver = np.zeros(master.units, np.uint32)
+
+    # -- cache plane construction ------------------------------------------
+
+    def make_cache(self):
+        """Zero-filled device cache plane of the master's layout. Unassigned
+        slots are never read (pulls only see slots the fault path installed),
+        so zeros are safe and skip the RNG init cost."""
+        shape = (self.budget,) + self.master.table.shape[1:]
+        table = jnp.zeros(shape, self.master.table.dtype)
+        slots = {
+            k: jnp.zeros((self.budget,) + v.shape[1:], v.dtype)
+            for k, v in self.master.slots.items()
+        }
+        if self.mesh is not None:
+            from swiftsnails_tpu.parallel.mesh import table_sharding
+
+            sh = table_sharding(self.mesh)
+            table = jax.device_put(table, sh)
+            slots = {k: jax.device_put(v, sh) for k, v in slots.items()}
+        return self.master.kind(table=table, slots=slots)
+
+    # -- id space ----------------------------------------------------------
+
+    def units_for(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(rows)
+        return rows // self.group if self.group > 1 else rows
+
+    def remap(self, rows: np.ndarray) -> np.ndarray:
+        """Master row ids -> cache-slot-space row ids (shape/dtype
+        preserved). Every unit must be resident (call :meth:`ensure` first)."""
+        rows = np.asarray(rows)
+        if self.group > 1:
+            units = rows // self.group
+            slots = self.slot_of[units]
+            out = slots * self.group + rows % self.group
+        else:
+            out = self.slot_of[rows]
+        if out.size and int(out.min()) < 0:
+            raise RuntimeError(
+                f"tiered[{self.name}]: remap hit a non-resident unit — "
+                "ensure() must cover every id the step touches")
+        return out.astype(rows.dtype)
+
+    def peek_missing(self, units: np.ndarray) -> np.ndarray:
+        """Sorted unique units not currently resident. Safe to call from the
+        staging thread — a stale answer only costs prefetch efficiency."""
+        uniq = np.unique(np.asarray(units).ravel())
+        return uniq[self.slot_of[uniq] < 0]
+
+    # -- fault path ---------------------------------------------------------
+
+    def ensure(self, cache, units: np.ndarray, *, staged=None,
+               mark_dirty: Optional[bool] = None):
+        """Make every unit resident; returns the updated cache state.
+
+        ``staged`` is an optional ``(sorted_units, unit_versions,
+        device_table_rows, {slot: device_rows})`` payload from the prefetch
+        thread — units found there at their staged write-back generation skip
+        the host gather + H2D copy on the critical path.
+        ``mark_dirty`` defaults to the table's write mode (training marks
+        every touched slot dirty — the push *will* write it; serving never
+        does).
+        """
+        if mark_dirty is None:
+            mark_dirty = not self.read_only
+        uniq = np.unique(np.asarray(units).ravel())
+        if uniq.size and (int(uniq[0]) < 0 or int(uniq[-1]) >= self.master.units):
+            raise ValueError(
+                f"tiered[{self.name}]: unit ids out of range "
+                f"[{uniq[0]}, {uniq[-1]}] for {self.master.units} units")
+        self.stats.lookups += int(uniq.size)
+        slots = self.slot_of[uniq]
+        resident = slots >= 0
+        hit_slots = slots[resident]
+        self.stats.hits += int(hit_slots.size)
+        self.ref[hit_slots] = np.minimum(
+            self.ref[hit_slots].astype(np.int64) + 1, 255
+        ).astype(np.uint8)
+        miss = uniq[~resident]
+        if miss.size:
+            if int(hit_slots.size) + int(miss.size) > self.budget:
+                raise RuntimeError(
+                    f"tiered[{self.name}]: the step touches "
+                    f"{int(hit_slots.size) + int(miss.size)} distinct cache "
+                    f"units but the HBM budget holds only {self.budget}; "
+                    "raise tier_hbm_budget_mb (or shrink the batch)")
+            new_slots = self._allocate(hit_slots, cache, int(miss.size))
+            self.unit_of[new_slots] = miss
+            self.slot_of[miss] = new_slots
+            self.ref[new_slots] = 1
+            self.dirty[new_slots] = False
+            self.stats.faults += 1
+            self.stats.faulted_rows += int(miss.size)
+            cache = self._install(cache, miss, new_slots, staged)
+        if mark_dirty and uniq.size:
+            self.dirty[self.slot_of[uniq]] = True
+        return cache
+
+    def _allocate(self, pinned_slots: np.ndarray, cache, n: int) -> np.ndarray:
+        """Grab ``n`` cache slots: unassigned first, then CLOCK eviction
+        (dirty victims are flushed to the master before reuse)."""
+        out = np.empty(n, np.int64)
+        k = 0
+        while k < n and self.used < self.budget:
+            out[k] = self.used
+            self.used += 1
+            k += 1
+        if k < n:
+            pinned = np.zeros(self.budget, bool)
+            pinned[pinned_slots] = True
+            pinned[out[:k]] = True
+            while k < n:
+                h = self.hand
+                self.hand = (self.hand + 1) % self.budget
+                if pinned[h]:
+                    continue
+                if self.ref[h] > 0:
+                    self.ref[h] >>= 1  # age; hot slots survive O(log) sweeps
+                    continue
+                out[k] = h
+                pinned[h] = True
+                k += 1
+            victims = out[self.unit_of[out] >= 0]
+            if victims.size:
+                self.stats.evictions += int(victims.size)
+                vd = victims[self.dirty[victims]]
+                if vd.size:
+                    self._flush_slots(cache, vd)
+                self.slot_of[self.unit_of[victims]] = -1
+                self.unit_of[victims] = -1
+        return out
+
+    def _install(self, cache, miss: np.ndarray, slots: np.ndarray, staged):
+        """Scatter the faulted units' rows into the cache plane — from the
+        staged device payload where available, from a host master gather for
+        the rest."""
+        host_miss, host_slots = miss, slots
+        if staged is not None:
+            s_units, s_vers, s_table, s_slots = staged
+            pos = np.searchsorted(s_units, miss)
+            pos_c = np.minimum(pos, max(len(s_units) - 1, 0))
+            ok = (
+                (len(s_units) > 0)
+                & (pos < len(s_units))
+                & (s_units[pos_c] == miss)
+                # stale staged row: the unit was flushed (fault -> update ->
+                # evict) after the stage gathered it — re-gather from master
+                & (s_vers[pos_c] == self.master_ver[miss])
+            )
+            if np.any(ok):
+                take = jnp.asarray(pos_c[ok].astype(np.int32))
+                idx = slots[ok]
+                cache = self._scatter_state(
+                    cache, idx, jnp.take(s_table, take, axis=0),
+                    {k: jnp.take(v, take, axis=0) for k, v in s_slots.items()},
+                )
+                host_miss, host_slots = miss[~ok], slots[~ok]
+        if host_miss.size:
+            t_rows, s_rows = self.master.gather(host_miss)
+            self.stats.h2d_bytes += t_rows.nbytes + sum(
+                v.nbytes for v in s_rows.values())
+            cache = self._scatter_state(cache, host_slots, t_rows, s_rows)
+        return cache
+
+    def _scatter_state(self, cache, idx: np.ndarray, table_rows, slot_rows):
+        """One bucketed scatter per leaf; pow2 padding (pad index == budget,
+        dropped by the OOB-drop scatter) bounds retraces logarithmically."""
+        n = int(np.asarray(idx).size)
+        b = _pow2(max(n, 1))
+        idx_p = np.full(b, self.budget, np.int32)
+        idx_p[:n] = np.asarray(idx)
+
+        def pad(vals):
+            if b == n:
+                return jnp.asarray(vals)
+            v = jnp.asarray(vals)
+            return jnp.concatenate(
+                [v, jnp.zeros((b - n,) + v.shape[1:], v.dtype)])
+
+        if self.mesh is not None:
+            from swiftsnails_tpu.parallel.transfer import scatter_slots_collective
+
+            table = scatter_slots_collective(
+                self.mesh, cache.table, idx_p, pad(table_rows))
+            slots = {
+                k: scatter_slots_collective(
+                    self.mesh, cache.slots[k], idx_p, pad(slot_rows[k]))
+                for k in cache.slots
+            }
+        else:
+            from swiftsnails_tpu.parallel.store import scatter_rows
+
+            table = scatter_rows(cache.table, idx_p, pad(table_rows))
+            slots = {
+                k: scatter_rows(cache.slots[k], idx_p, pad(slot_rows[k]))
+                for k in cache.slots
+            }
+        return self.master.kind(table=table, slots=slots)
+
+    # -- write-back ----------------------------------------------------------
+
+    def _flush_slots(self, cache, slots: np.ndarray) -> None:
+        """Device -> host write-back of specific cache slots into the master
+        (bucketed gather; padding reads slot 0 and is sliced off)."""
+        from swiftsnails_tpu.parallel.store import gather_rows
+
+        n = int(slots.size)
+        b = _pow2(max(n, 1))
+        idx_p = np.zeros(b, np.int32)
+        idx_p[:n] = slots
+        t_rows = np.asarray(jax.device_get(gather_rows(cache.table, idx_p)))[:n]
+        s_rows = {
+            k: np.asarray(jax.device_get(gather_rows(v, idx_p)))[:n]
+            for k, v in cache.slots.items()
+        }
+        self.master.scatter(self.unit_of[slots], t_rows, s_rows)
+        # bump AFTER the scatter: a staging-thread version read that races the
+        # write-back sees the old generation and the install discards its row
+        self.master_ver[self.unit_of[slots]] += 1
+        self.stats.d2h_bytes += t_rows.nbytes + sum(
+            v.nbytes for v in s_rows.values())
+        self.stats.flushes += 1
+        self.stats.flushed_rows += n
+        self.dirty[slots] = False
+
+    def flush(self, cache) -> None:
+        """Write every dirty slot back to the master. After this the master
+        holds the exact resident-table content (the write-back invariant);
+        the cache stays mapped, so training continues without refaulting."""
+        d = np.nonzero(self.dirty)[0]
+        if d.size:
+            self._flush_slots(cache, d)
+
+    # -- admission seeding ----------------------------------------------------
+
+    def prewarm(self, cache, units: np.ndarray):
+        """Fault the given units (hottest-first) before step 0, clean. Takes
+        at most ``budget`` units; seeds their CLOCK counters so the zipf head
+        outlives the first eviction sweeps."""
+        units = np.asarray(units).ravel()
+        if units.size == 0:
+            return cache
+        # stable unique: keep hottest-first order, drop later duplicates
+        _, first = np.unique(units, return_index=True)
+        units = units[np.sort(first)][: self.budget]
+        cache = self.ensure(cache, units, mark_dirty=False)
+        self.ref[self.slot_of[units]] = 3  # survive the first sweeps
+        self.stats.prewarmed_rows += int(units.size)
+        return cache

@@ -145,7 +145,11 @@ def test_collective_paths_match_pjit(mesh):
     np.testing.assert_allclose(
         np.asarray(got.slots["accum"]), np.asarray(want.slots["accum"]), rtol=1e-5
     )
-    assert got.table.sharding == table_sharding(mesh)
+    # equivalence, not equality: newer jax spells the committed sharding
+    # PartitionSpec('model',) while table_sharding builds ('model', None) —
+    # the same placement
+    assert got.table.sharding.is_equivalent_to(
+        table_sharding(mesh), got.table.ndim)
 
 
 def test_pull_push_roundtrip_training_effect(mesh):
