@@ -129,7 +129,8 @@ _state = {
     "chaos": None,  # resilience lane (dict; see measure_chaos / --lane chaos)
     "serving": None,  # read-path latency lane (dict; see --lane serve)
     "tiered": None,  # host-tier parameter store lane (dict; see --lane tiered)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered)
+    "chaos_serve": None,  # serving availability drill (dict; --lane chaos-serve)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -238,6 +239,7 @@ def _result_json(extra_error=None):
             "chaos": _state["chaos"],
             "serving": _state["serving"],
             "tiered": _state["tiered"],
+            "chaos_serve": _state["chaos_serve"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1199,6 +1201,68 @@ def run_tiered_lane() -> int:
     return 0
 
 
+# -- chaos-serve (availability drill) lane -------------------------------------
+#
+# `--lane chaos-serve` runs the serving availability drill (`swiftsnails_tpu/
+# serving/chaos_lane.py`): a seeded fault matrix (read-error storms + stalls)
+# against a live Servant, once with circuit breakers + degraded stale-LRU
+# reads (availability must hold the floor) and once unprotected (the same
+# matrix must hard-fail), plus the corrupt-reload rejection drill and the
+# tiered bit-flip recovery drill. Availability under fault is correctness,
+# so the lane is valid on CPU; the block lands in the result JSON
+# (`chaos_serve`), the run ledger, and the `ledger-report
+# --check-regression` gate on any platform.
+
+
+def measure_chaos_serve() -> None:
+    """Populate ``_state['chaos_serve']`` with the availability-drill block."""
+    from swiftsnails_tpu.serving.chaos_lane import chaos_serve_bench
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    block = chaos_serve_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["chaos_serve"] = block
+    print(
+        f"bench: chaos-serve lane: availability {block.get('availability_pct')}% "
+        f"(floor {block.get('floor_pct')}%) "
+        f"degraded share {block.get('degraded_share_pct')}% "
+        f"p99 under fault {block.get('p99_under_fault_ms')}ms "
+        f"control hard-failure {block.get('unprotected_hard_failure')}",
+        file=sys.stderr,
+    )
+
+
+def run_chaos_serve_lane() -> int:
+    """``--lane chaos-serve``: the availability drill alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "chaos-serve"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_chaos_serve()
+    except Exception as e:
+        _state["errors"].append(
+            f"chaos-serve lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["chaos_serve"]
+    # the lane's headline is availability under fault, not a rate — leave
+    # the perf headline empty and gate on the lane's own pass criteria
+    _state["best_path"] = "chaos-serve"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    ok = (
+        (block.get("availability_pct") or 0) >= block.get("floor_pct", 99.0)
+        and block.get("unprotected_hard_failure")
+        and block.get("reload_corrupt_rejected")
+        and (block.get("tier_bitflip") is None
+             or block["tier_bitflip"].get("recovered"))
+    )
+    return 0 if ok else 1
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -1551,13 +1615,17 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="bench", description="word2vec words/sec/chip benchmark")
     parser.add_argument(
-        "--lane", choices=("full", "chaos", "serve", "tiered"), default="full",
+        "--lane", choices=("full", "chaos", "serve", "tiered", "chaos-serve"),
+        default="full",
         help="full = the headline bench (default); chaos = the resilience "
              "lane alone (guardrail overhead + scripted-fault recovery "
              "drills; valid on CPU); serve = the read-path latency lane "
              "(pull/top-k/CTR-score qps + p50/p95/p99; valid on CPU); "
              "tiered = the host-tier parameter store lane (words/sec vs "
-             "resident + over-budget round trip; valid on CPU)",
+             "resident + over-budget round trip; valid on CPU); chaos-serve "
+             "= the serving availability drill (fault matrix vs a live "
+             "Servant with breakers + degraded reads, corrupt-reload and "
+             "tier bit-flip drills; valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -1569,6 +1637,8 @@ def main(argv=None):
         return run_serve_lane()
     if args.lane == "tiered":
         return run_tiered_lane()
+    if args.lane == "chaos-serve":
+        return run_chaos_serve_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

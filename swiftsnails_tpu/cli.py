@@ -120,11 +120,12 @@ def cmd_serve(argv: List[str]) -> int:
         topk <id> [k]                nearest rows to row <id> (cosine)
         score <f0> <f1> ...          CTR probability (registry models)
         stats                        latency/cache/shed snapshot
+        health                       breaker / tier / version state
         quit
     """
     import json
 
-    from swiftsnails_tpu.serving import Overloaded, Servant
+    from swiftsnails_tpu.serving import Overloaded, Servant, Unavailable
     from swiftsnails_tpu.telemetry.ledger import Ledger
 
     cfg = parse_role_argv(argv)
@@ -136,7 +137,7 @@ def cmd_serve(argv: List[str]) -> int:
         print(
             f"serving step {servant.step} tables "
             f"{servant.stats()['tables']} (one request per line; "
-            "pull/topk/score/stats/quit)",
+            "pull/topk/score/stats/health/quit)",
             file=sys.stderr,
         )
         for line in sys.stdin:
@@ -161,10 +162,14 @@ def cmd_serve(argv: List[str]) -> int:
                     out = {"scores": [round(float(s), 6) for s in scores]}
                 elif op == "stats":
                     out = servant.stats()
+                elif op == "health":
+                    out = servant.health()
                 else:
                     out = {"error": f"unknown op {op!r}"}
             except Overloaded as e:
                 out = {"error": f"overloaded: {e}", "shed": True}
+            except Unavailable as e:
+                out = {"error": f"unavailable: {e}", "shed": True}
             except Exception as e:  # noqa: BLE001 — a REPL must not die
                 out = {"error": f"{type(e).__name__}: {e}"}
             print(json.dumps(out), flush=True)

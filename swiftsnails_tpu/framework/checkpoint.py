@@ -282,6 +282,7 @@ def save_checkpoint(
     protect: Optional[int] = None,
     ledger=None,
     tier=None,
+    retry=None,
 ) -> str:
     """Write a sharded checkpoint for ``step`` under ``root`` (param_backup
     parity), committed by a checksum manifest.
@@ -315,8 +316,15 @@ def save_checkpoint(
     ckptr = _checkpointer()
     try:
         # orbax's save first joins any in-flight background save, so by the
-        # time it returns every previously-pending manifest is committable
-        ckptr.save(path, state, force=True)
+        # time it returns every previously-pending manifest is committable.
+        # `retry` (a resilience.retry.RetryPolicy) absorbs transient OSError
+        # from the storage layer; exhaustion is its own ledger event before
+        # the error propagates here.
+        if retry is not None:
+            retry.call(ckptr.save, path, state, force=True,
+                       op=f"ckpt_save:step_{step}")
+        else:
+            ckptr.save(path, state, force=True)
     except Exception as e:
         _note_error(f"checkpoint save failed for {path}: {e}", ledger)
         raise
@@ -403,7 +411,7 @@ def candidate_steps(root: str, preferred: Sequence[int] = ()) -> List[int]:
 
 
 def load_tables(
-    root: str, step: Optional[int] = None, verify: bool = True
+    root: str, step: Optional[int] = None, verify: bool = True, retry=None
 ) -> Tuple[Any, Dict]:
     """Query-only restore: ``(state_tree, manifest)`` with no trainer needed.
 
@@ -426,7 +434,13 @@ def load_tables(
     for s in steps:
         path = _step_dir(root, s)
         try:
-            restored = ckptr.restore(path)
+            # transient storage errors retry under the shared policy; a
+            # genuinely unreadable step falls through to the next candidate
+            if retry is not None:
+                restored = retry.call(
+                    ckptr.restore, path, op=f"ckpt_load:step_{s}")
+            else:
+                restored = ckptr.restore(path)
         except Exception as e:
             rejections.append(f"step_{s}: {type(e).__name__}: {e}")
             continue

@@ -203,39 +203,6 @@ class _Prefetcher:
 _STREAM_END = object()
 
 
-class _RetryingStream:
-    """Iterator adapter that survives transient ``OSError`` from the batch
-    stream (a flaky filesystem read, or a chaos-injected
-    :class:`~swiftsnails_tpu.resilience.chaos.TransientDataError`): each
-    failed fetch is retried up to ``retries`` times before the error
-    propagates. Only wrapped in when resilience is active — the plain hot
-    path keeps the raw iterator."""
-
-    def __init__(self, inner, retries: int = 3, on_error=None):
-        self._inner = inner
-        self.retries = retries
-        self._on_error = on_error
-        self.retried = 0
-
-    def __iter__(self):
-        return self
-
-    def __next__(self):
-        for attempt in range(self.retries + 1):
-            try:
-                return next(self._inner)
-            except StopIteration:
-                raise
-            except OSError as e:
-                recovered = attempt < self.retries
-                self.retried += 1
-                if self._on_error is not None:
-                    self._on_error(e, attempt, recovered)
-                if not recovered:
-                    raise
-        raise AssertionError("unreachable")
-
-
 class TrainLoop:
     """The driver: jit with state donation, device feed, metrics, checkpoints."""
 
@@ -274,13 +241,18 @@ class TrainLoop:
             # async periodic saves: training continues while shards write;
             # the manifest (step, config hash, CRCs, data cursor) commits
             # when the write lands, and retention prunes old generations
+            from swiftsnails_tpu.resilience.retry import RetryPolicy
+
+            ckpt_retry = RetryPolicy.from_config(cfg)
+
             def checkpoint_fn(state, step):
+                ckpt_retry.ledger = self.ledger  # ledger binds below
                 save_checkpoint(
                     self.backup_root, state, step, wait=False,
                     cursor={"step": step, "items": self._items_seen},
                     config_hash=self.config_hash,
                     keep=self.backup_keep, protect=self._restored_step,
-                    ledger=self.ledger, tier=self.tier,
+                    ledger=self.ledger, tier=self.tier, retry=ckpt_retry,
                 )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
@@ -354,6 +326,10 @@ class TrainLoop:
             self.tier = TierManager(trainer, registry=self.registry)
         else:
             self.tier = None
+        # tier integrity sweep cadence (steps; 0 = only at heal requests).
+        # Runs on the resilient path only — like chaos/guardrail, arming it
+        # costs the plain hot path nothing.
+        self.tier_verify_period = cfg.get_int("tier_verify_period", 0)
         # per-step dispatch cost trimming: the batch/replicated shardings are
         # mesh properties — build them ONCE instead of per step, and fold the
         # per-step RNG derivation into the jitted step itself (the step
@@ -439,13 +415,23 @@ class TrainLoop:
         bb = self.blackbox
         guard = self.guardrail
         chaos = self.chaos
-        resilient = guard is not None or chaos is not None
+        resilient = (guard is not None or chaos is not None
+                     or (tier is not None and self.tier_verify_period > 0))
         self._install_sigterm()
         it = iter(batches)
         if chaos is not None:
             it = chaos.wrap_stream(it)
         if resilient:
-            it = _RetryingStream(it, on_error=self._on_stream_error)
+            # transient OSError (flaky filesystem, chaos TransientDataError)
+            # survives under the shared retry policy; exhaustion is a durable
+            # retry_exhausted ledger event before the error propagates
+            from swiftsnails_tpu.resilience.retry import (
+                RetryingIterator, RetryPolicy)
+
+            policy = RetryPolicy.from_config(
+                self.trainer.config, ledger=self.ledger)
+            it = RetryingIterator(
+                it, policy, on_error=self._on_stream_error, op="data_stream")
         if skip_batches:
             for _ in range(skip_batches):
                 if next(it, _STREAM_END) is _STREAM_END:
@@ -658,10 +644,46 @@ class TrainLoop:
                 )
         if chaos is not None:
             chaos.maybe_corrupt_checkpoint(self.backup_root, step)
+            if self.tier is not None:
+                chaos.maybe_flip_tier(self.tier, step)
             reason = chaos.wants_preempt(step)
             if reason is not None:
                 self.request_preemption(reason)
+        if (self.tier is not None and self.tier_verify_period
+                and (step + 1) % self.tier_verify_period == 0):
+            self._tier_integrity_sweep(new_state, step)
         return new_state, metrics
+
+    def _tier_integrity_sweep(self, state, step: int) -> None:
+        """Recompute the host masters' plane digests; on a mismatch,
+        quarantine-and-rebuild from the newest verified checkpoint (the cache
+        plane — which the corruption cannot reach — is re-asserted on top,
+        so only units evicted since that checkpoint roll back). Failing to
+        find a trustworthy checkpoint raises: silently training on a corrupt
+        master is the one outcome this sweep exists to prevent."""
+        bad = self.tier.verify()
+        if not bad:
+            return
+        print(
+            f"tier integrity: corrupt master plane(s) at step {step}: "
+            + ", ".join(f"{t}[{', '.join(p)}]" for t, p in bad.items())
+            + "; rebuilding from newest verified checkpoint",
+            file=sys.stderr,
+        )
+        from swiftsnails_tpu.resilience.retry import RetryPolicy
+
+        policy = RetryPolicy.from_config(self.trainer.config, ledger=self.ledger)
+        ckpt_step, rebuilt = self.tier.heal(
+            state, self.backup_root, corrupt=bad, retry_policy=policy)
+        if self.registry is not None:
+            self.registry.counter("tier_heals").inc()
+        self._ledger_event("cache_error", {
+            "source": "tier",
+            "step": step,
+            "planes": {t: list(p) for t, p in bad.items()},
+            "rebuilt_from_step": ckpt_step,
+            "tables": rebuilt,
+        })
 
     def request_preemption(self, reason: str = "SIGTERM") -> None:
         """Ask the loop to drain at the next step boundary: final save,

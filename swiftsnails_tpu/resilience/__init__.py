@@ -17,7 +17,12 @@ The observability stack (PRs 1-2: tracer, ledger, black box, goodput) is the
   (``resume: auto``, with ``framework/checkpoint.py``);
 * :mod:`~swiftsnails_tpu.resilience.drill` — the canned chaos drill matrix
   and the bench ``chaos`` lane's recovery-goodput measurement
-  (``bench.py --lane chaos``, ``tools/chaos_drill.py``).
+  (``bench.py --lane chaos``, ``tools/chaos_drill.py``);
+* :mod:`~swiftsnails_tpu.resilience.retry` — the unified deadline + retry
+  policy (exponential backoff, decorrelated jitter, injectable clock) that
+  every fallible host I/O path shares: the data stream, checkpoint
+  save/restore, tier master flush/gather, Servant reload
+  (``retry_max_attempts``, ``retry_deadline_ms``).
 
 Cost contract: nothing here is imported unless a resilience config key is
 set; the TrainLoop hot path pays flag checks only.
@@ -32,15 +37,31 @@ from swiftsnails_tpu.resilience.chaos import (
 )
 from swiftsnails_tpu.resilience.guardrail import GuardrailExhausted, StepGuardrail
 from swiftsnails_tpu.resilience.resume import resume_mode, resume_state
+from swiftsnails_tpu.resilience.retry import (
+    Deadline,
+    DeadlineExceeded,
+    RetryBudget,
+    RetryExhausted,
+    RetryingIterator,
+    RetryPolicy,
+    retry_call,
+)
 
 __all__ = [
     "ChaosPlan",
     "ChaosSpecError",
+    "Deadline",
+    "DeadlineExceeded",
     "GuardrailExhausted",
+    "RetryBudget",
+    "RetryExhausted",
+    "RetryingIterator",
+    "RetryPolicy",
     "StepGuardrail",
     "TransientDataError",
     "corrupt_checkpoint_dir",
     "parse_chaos_spec",
     "resume_mode",
     "resume_state",
+    "retry_call",
 ]

@@ -24,6 +24,17 @@ never recompiled or slowed by the harness):
                 ``param_backup_root`` — bit rot the manifest CRC must catch
 ``preempt``     requests a simulated SIGTERM at the step boundary — the
                 TrainLoop drains, final-saves, and records an ``outage``
+``serve_io_error`` a Servant kernel dispatch raises ``OSError`` at the
+                scheduled request index — a flaky storage/device read on the
+                serving read path (drives the circuit breakers)
+``serve_slow``  a Servant kernel dispatch stalls past its latency budget at
+                the scheduled request index — a straggling device
+``tier_bitflip`` XORs one seeded-random bit directly in a tiered host master
+                plane, bypassing ``scatter`` — silent host-RAM corruption
+                that only ``HostMaster.verify()``'s digests can catch
+``reload_corrupt`` corrupts the newest on-disk checkpoint right before a
+                live Servant reload — the shadow-verify swap must reject it
+                and keep serving the old version
 ==============  ============================================================
 
 Every injection appends a ``chaos`` ledger event (when a ledger is wired),
@@ -41,6 +52,10 @@ import numpy as np
 
 FAULT_KINDS = (
     "nan_grad", "inf_grad", "row_poison", "io_error", "ckpt_corrupt", "preempt",
+    # availability-hardening kinds (PR 7): serving + tiered-store faults.
+    # The serve_* kinds index by REQUEST number (the serving fault hook),
+    # tier_bitflip/reload_corrupt by train step / drill index.
+    "serve_io_error", "serve_slow", "tier_bitflip", "reload_corrupt",
 )
 
 _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<first>\d+)(?:-(?P<last>\d+))?$")
@@ -271,6 +286,47 @@ class ChaosPlan:
         path = corrupt_checkpoint_dir(root, rng=self.rng)
         self._log("ckpt_corrupt", step, {"path": path})
         return path
+
+    def maybe_flip_tier(self, tier, step: int) -> Optional[str]:
+        """``tier_bitflip``: XOR one seeded-random bit directly in a host
+        master plane's memory — deliberately bypassing
+        :meth:`HostMaster.scatter` so only the integrity digests
+        (:meth:`HostMaster.verify`) can catch it. Returns the hit table."""
+        if not self._take("tier_bitflip", step):
+            return None
+        names = sorted(tier.tables)
+        if not names:
+            self._log("tier_bitflip", step, {"detail": "skipped: no tier"})
+            return None
+        name = names[int(self.rng.integers(0, len(names)))]
+        flat = tier.tables[name].master.table.view(np.uint8).reshape(-1)
+        off = int(self.rng.integers(0, flat.size))
+        bit = int(self.rng.integers(0, 8))
+        flat[off] ^= np.uint8(1 << bit)
+        self._log("tier_bitflip", step,
+                  {"table": name, "plane": "table", "byte": off, "bit": bit})
+        return name
+
+    # -- serving-surface faults (consulted by the Servant's fault hook / the
+    # chaos-serve lane; "step" is the request index) -------------------------
+
+    def serve_fault(self, index: int) -> Optional[str]:
+        """The scheduled serving fault for request ``index`` (at most one:
+        ``serve_io_error`` outranks ``serve_slow``), or None."""
+        for kind in ("serve_io_error", "serve_slow"):
+            if self._take(kind, index):
+                self._log(kind, index, {"surface": "serve"})
+                return kind
+        return None
+
+    def wants_reload_corrupt(self, index: int) -> bool:
+        """True when a ``reload_corrupt`` drill is scheduled at ``index`` —
+        the caller corrupts the newest checkpoint *before* asking the live
+        Servant to reload it (the shadow-verify swap must reject it)."""
+        if self._take("reload_corrupt", index):
+            self._log("reload_corrupt", index, {"surface": "serve"})
+            return True
+        return False
 
     def summary(self) -> Dict:
         return {

@@ -31,6 +31,7 @@ DRILLS = (
     "io_error",
     "ckpt_walkback",
     "preempt_resume",
+    "tier_bitflip",
 )
 
 
@@ -260,6 +261,69 @@ def drill_preempt_resume(workdir: Optional[str] = None, steps: int = 24,
     }
 
 
+def drill_tier_bitflip(workdir: Optional[str] = None, steps: int = 12,
+                       flip_at: int = 6, **_ignored) -> Dict:
+    """Silent host-RAM corruption of a tiered master plane: a seeded bit is
+    XOR'd directly into a :class:`HostMaster` plane (bypassing ``scatter``,
+    so only the integrity digests can see it). The per-step verify sweep
+    must detect the corrupt plane, rebuild it from the newest verified
+    checkpoint with the resident cache re-asserted on top, and the run must
+    finish with eval loss at parity with an unfaulted tiered control."""
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    workdir = _workdir(workdir)
+    tier_cfg = {
+        "table_tier": "host",
+        "tier_verify_period": 1,
+        "steps_per_call": 1,
+        "param_backup_period": 2,
+    }
+
+    # unfaulted tiered control (same step semantics, no chaos)
+    ctl_dir = os.path.join(workdir, "control")
+    os.makedirs(ctl_dir, exist_ok=True)
+    ctl_tr = make_trainer(ctl_dir, param_backup_root=os.path.join(ctl_dir, "ck"),
+                          **tier_cfg)
+    _, ctl_state, _ = run_loop(ctl_tr, max_steps=steps)
+    loss_control = eval_loss(ctl_tr, ctl_state)
+
+    # faulted leg: the flip lands at `flip_at`, after checkpoints exist
+    flt_dir = os.path.join(workdir, "faulted")
+    os.makedirs(flt_dir, exist_ok=True)
+    trainer = make_trainer(
+        flt_dir, param_backup_root=os.path.join(flt_dir, "ck"),
+        chaos_spec=f"tier_bitflip@{flip_at}", chaos_seed=11, **tier_cfg)
+    loop, state, steps_done = run_loop(trainer, max_steps=steps)
+    loss_faulted = eval_loss(trainer, state)
+    parity = abs(loss_faulted - loss_control) / max(abs(loss_control), 1e-9)
+
+    flips = [e for e in loop.chaos.events if e["fault"] == "tier_bitflip"]
+    heal = None
+    ledger = Ledger(os.path.join(flt_dir, "LEDGER.jsonl"))
+    for r in ledger.records("cache_error"):
+        if r.get("source") == "tier":
+            heal = r
+    detected = heal is not None and heal.get("rebuilt_from_step") is not None
+    return {
+        "recovered": bool(
+            steps_done == steps
+            and len(flips) == 1
+            and detected
+            and tables_finite(state)
+            and parity <= LOSS_PARITY_BAR
+        ),
+        "steps": steps_done,
+        "flip": flips[0] if flips else None,
+        "detected_planes": (heal or {}).get("planes"),
+        "rebuilt_from_step": (heal or {}).get("rebuilt_from_step"),
+        "rebuilt_tables": (heal or {}).get("tables"),
+        "loss_control": round(loss_control, 6),
+        "loss_faulted": round(loss_faulted, 6),
+        "loss_parity": round(parity, 6),
+        "parity_bar": LOSS_PARITY_BAR,
+    }
+
+
 _DRILL_FNS: Dict[str, Callable[..., Dict]] = {
     "nan_burst": drill_nan_burst,
     "inf_update": drill_inf_update,
@@ -267,6 +331,7 @@ _DRILL_FNS: Dict[str, Callable[..., Dict]] = {
     "io_error": drill_io_error,
     "ckpt_walkback": drill_ckpt_walkback,
     "preempt_resume": drill_preempt_resume,
+    "tier_bitflip": drill_tier_bitflip,
 }
 
 FAST_DRILLS = ("nan_burst", "io_error", "ckpt_walkback")

@@ -3,9 +3,12 @@
 Load a verified checkpoint into read-only sharded tables and serve three
 jitted query kernels — row pull, top-k nearest-neighbor, CTR score — behind
 a micro-batcher with a hot-row LRU cache and bounded-queue admission
-control. See ``docs/SERVING.md``.
+control. Availability hardening: per-kernel circuit breakers with
+degraded-mode (stale-LRU) reads and typed :class:`Unavailable` sheds.
+See ``docs/SERVING.md``.
 """
 
+from swiftsnails_tpu.serving.breaker import CircuitBreaker, Unavailable
 from swiftsnails_tpu.serving.cache import HotRowCache
 from swiftsnails_tpu.serving.engine import (
     MicroBatcher,
@@ -22,10 +25,12 @@ from swiftsnails_tpu.serving.kernels import (
 )
 
 __all__ = [
+    "CircuitBreaker",
     "HotRowCache",
     "MicroBatcher",
     "Overloaded",
     "Servant",
+    "Unavailable",
     "bucket_for",
     "ctr_logits",
     "ctr_scores",

@@ -6,7 +6,10 @@ Correctness rules:
 
 * Entries are keyed ``(table_name, row_id)`` and stamped with the table
   **version** the row was pulled at. A version bump (table reload) makes
-  every older entry a miss — stale rows can never be served after a reload.
+  every older entry a miss — stale rows can never be served *fresh* after a
+  reload. They stay in the LRU though (overwritten by the next fresh pull or
+  aged out by capacity): :meth:`HotRowCache.get_stale` reads them for
+  DEGRADED serves when the pull kernel's circuit breaker is open.
 * The micro-batcher's pad sentinel (row id 0 in the pad tail) must never be
   inserted: the engine only inserts the rows of *real* requests, and
   ``put`` additionally drops rows explicitly flagged as padding.
@@ -56,8 +59,10 @@ class HotRowCache:
                     found[i] = entry[1]
                     self.hits += 1
                 else:
-                    if entry is not None:  # stale version: evict eagerly
-                        self._rows.pop((table, i), None)
+                    # A version-stale entry is a miss but is NOT evicted: it
+                    # is the inventory for degraded reads (breaker open after
+                    # a reload). The fresh put overwrites it; otherwise plain
+                    # LRU pressure ages it out.
                     missing.append(i)
                     self.misses += 1
         return found, missing
@@ -90,6 +95,29 @@ class HotRowCache:
             while len(self._rows) > self.capacity:
                 self._rows.popitem(last=False)
         return admitted
+
+    def get_stale(
+        self, table: str, ids: np.ndarray
+    ) -> Tuple[Dict[int, np.ndarray], List[int]]:
+        """Version-agnostic, side-effect-free peek for DEGRADED reads only
+        (circuit breaker open / kernel dispatch failed): returns whatever the
+        LRU still holds for ``ids`` regardless of the version stamp.
+
+        Deliberately touches nothing — no hit/miss counters (degraded serves
+        are accounted separately and never mixed into the fresh-path stats),
+        no eviction, no LRU reordering (the fresh traffic alone decides what
+        stays hot)."""
+        found: Dict[int, np.ndarray] = {}
+        missing: List[int] = []
+        with self._lock:
+            for i in ids:
+                i = int(i)
+                entry = self._rows.get((table, i))
+                if entry is not None:
+                    found[i] = entry[1]
+                else:
+                    missing.append(i)
+        return found, missing
 
     def clear(self) -> None:
         with self._lock:

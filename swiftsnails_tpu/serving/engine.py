@@ -29,6 +29,22 @@ with a typed :class:`Overloaded` instead of stalling the caller, counts a
 shed, and (rate-limited) records an ``overload`` ledger event that
 ``ledger-report --failures`` renders.
 
+**Availability.** Each kernel sits behind a closed/open/half-open
+:class:`~swiftsnails_tpu.serving.breaker.CircuitBreaker`
+(``breaker_threshold`` consecutive dispatch failures trip it;
+``breaker_cooldown_ms`` later a half-open probe decides). While a pull
+breaker is open — or when a pull dispatch fails outright — the request is
+served DEGRADED from the hot-row LRU when every id is present (counted as
+``serve.pull.degraded`` / ``degraded_hits``, never mixed into the fresh
+counters); otherwise it sheds with a typed
+:class:`~swiftsnails_tpu.serving.breaker.Unavailable`. ``topk``/``score``
+have no row cache to degrade from, so an open breaker sheds them.
+``serve_degraded: 0`` disables the stale fallback (strict freshness).
+:meth:`Servant.reload_from_checkpoint` is shadow-load → CRC verify →
+atomic version swap: a corrupt newer checkpoint is rejected while the live
+tables keep serving. :meth:`Servant.health` (and the serve REPL's
+``health`` command) exposes breaker/tier/version state.
+
 Latency histograms (p50/p95/p99) and cache-hit/shed counters feed the
 shared telemetry :class:`~swiftsnails_tpu.telemetry.registry.MetricRegistry`
 and the run ledger.
@@ -46,10 +62,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from swiftsnails_tpu.serving.breaker import CLOSED, CircuitBreaker, Unavailable
 from swiftsnails_tpu.serving.cache import HotRowCache
 from swiftsnails_tpu.serving.kernels import pull_rows, topk_tiled
 
 DEFAULT_BUCKETS = (8, 64)
+DEFAULT_BREAKER_THRESHOLD = 5
+DEFAULT_BREAKER_COOLDOWN_MS = 1_000.0
+DEFAULT_BREAKER_PROBES = 1
 DEFAULT_CACHE_ROWS = 4096
 DEFAULT_QUEUE_DEPTH = 64
 DEFAULT_TOPK = 10
@@ -109,6 +129,40 @@ def normalize_table(
         rows = a[:, 0, :].reshape(t * g, stride)
         return rows[:cap, :dim]
     raise ValueError(f"unknown table layout {layout!r}")
+
+
+def _normalize_state_tables(state, config, scorer, mesh):
+    """Checkpoint state tree -> ``(tables, dense, default_table)``: the one
+    normalization used by both the cold start (:meth:`Servant.from_checkpoint`)
+    and the live shadow reload (:meth:`Servant.reload_from_checkpoint`).
+    ``scorer`` carries the CTR geometry (None for word2vec)."""
+    model_name = config.get_str("model", "word2vec")
+    if model_name == "word2vec":
+        dim = config.get_int("dim", 100)
+        layout = "packed" if config.get_bool("packed", True) else "dense"
+        tables = {
+            name: normalize_table(state[name]["table"], dim, layout)
+            for name in ("in_table", "out_table")
+            if name in state
+        }
+        dense = None
+        default_table = "in_table"
+    else:
+        layout = "packed_small" if scorer.packed else "dense"
+        tables = {
+            "table": normalize_table(
+                state["table"]["table"], scorer.table_dim, layout,
+                capacity=scorer.capacity,
+            )
+        }
+        dense = state.get("dense") or {}
+        default_table = "table"
+    if mesh is not None:
+        from swiftsnails_tpu.parallel.mesh import table_sharding
+
+        sharding = table_sharding(mesh)
+        tables = {k: jax.device_put(v, sharding) for k, v in tables.items()}
+    return tables, dense, default_table
 
 
 # ------------------------------------------------------------ micro-batch ---
@@ -265,6 +319,10 @@ class Servant:
         topk_tile_rows: int = 4096,
         default_table: Optional[str] = None,
         tier_hbm_budget_mb: float = 0.0,
+        breaker_threshold: int = DEFAULT_BREAKER_THRESHOLD,
+        breaker_cooldown_ms: float = DEFAULT_BREAKER_COOLDOWN_MS,
+        breaker_halfopen_probes: int = DEFAULT_BREAKER_PROBES,
+        degraded: bool = True,
     ):
         if not tables:
             raise ValueError("Servant needs at least one table")
@@ -309,7 +367,27 @@ class Servant:
             for k in ("pull", "topk", "score")
         }
         self._shed_events = 0  # overload ledger events already written
+        self._degraded_events = 0  # degraded ledger events already written
         self._lock = threading.Lock()
+        # availability layer: per-kernel breakers (threshold 0 disables) +
+        # degraded-mode stale reads. `fault_hook` is the seeded chaos
+        # injection point — fn(kernel, dispatch_index) may raise or stall,
+        # exactly as a sick device/storage read would (chaos-serve lane).
+        self.degraded_enabled = bool(degraded)
+        self.fault_hook = None
+        self._dispatch_seq = {"pull": 0, "topk": 0, "score": 0}
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        if int(breaker_threshold) > 0:
+            self.breakers = {
+                k: CircuitBreaker(
+                    k,
+                    threshold=int(breaker_threshold),
+                    cooldown_ms=float(breaker_cooldown_ms),
+                    halfopen_probes=int(breaker_halfopen_probes),
+                    on_transition=self._on_breaker_transition,
+                )
+                for k in ("pull", "topk", "score")
+            }
 
         self._pull_fn = jax.jit(
             lambda table, rows: pull_rows(
@@ -424,17 +502,8 @@ class Servant:
 
         state, manifest = load_tables(root, step=step)
         model_name = config.get_str("model", "word2vec")
-        scorer = dense = None
-        if model_name == "word2vec":
-            dim = config.get_int("dim", 100)
-            layout = "packed" if config.get_bool("packed", True) else "dense"
-            tables = {
-                name: normalize_table(state[name]["table"], dim, layout)
-                for name in ("in_table", "out_table")
-                if name in state
-            }
-            default_table = "in_table"
-        else:
+        scorer = None
+        if model_name != "word2vec":
             from swiftsnails_tpu.models.registry import get_model
 
             trainer_cls = get_model(model_name)
@@ -446,20 +515,8 @@ class Servant:
                 data=(np.zeros(0, np.float32),
                       np.zeros((0, n_fields), np.int32)),
             )
-            layout = "packed_small" if scorer.packed else "dense"
-            tables = {
-                "table": normalize_table(
-                    state["table"]["table"], scorer.table_dim, layout,
-                    capacity=scorer.capacity,
-                )
-            }
-            dense = state.get("dense") or {}
-            default_table = "table"
-        if mesh is not None:
-            from swiftsnails_tpu.parallel.mesh import table_sharding
-
-            sharding = table_sharding(mesh)
-            tables = {k: jax.device_put(v, sharding) for k, v in tables.items()}
+        tables, dense, default_table = _normalize_state_tables(
+            state, config, scorer, mesh)
         kwargs.setdefault("batch_buckets", _int_list(
             config.get_str("serve_batch_buckets", ""), DEFAULT_BUCKETS))
         kwargs.setdefault("cache_rows",
@@ -468,6 +525,13 @@ class Servant:
                           config.get_int("serve_queue_depth", DEFAULT_QUEUE_DEPTH))
         kwargs.setdefault("topk", config.get_int("serve_topk", DEFAULT_TOPK))
         kwargs.setdefault("comm_dtype", config.get_str("comm_dtype", "float32"))
+        kwargs.setdefault("breaker_threshold", config.get_int(
+            "breaker_threshold", DEFAULT_BREAKER_THRESHOLD))
+        kwargs.setdefault("breaker_cooldown_ms", config.get_float(
+            "breaker_cooldown_ms", DEFAULT_BREAKER_COOLDOWN_MS))
+        kwargs.setdefault("breaker_halfopen_probes", config.get_int(
+            "breaker_halfopen_probes", DEFAULT_BREAKER_PROBES))
+        kwargs.setdefault("degraded", config.get_bool("serve_degraded", True))
         if config.get_str("table_tier", "device") == "host":
             kwargs.setdefault(
                 "tier_hbm_budget_mb",
@@ -499,6 +563,42 @@ class Servant:
             self.version += 1
             return self.version
 
+    def reload_from_checkpoint(self, root: str, config, *,
+                               step: Optional[int] = None,
+                               retry=None) -> int:
+        """Shadow-load → CRC verify → atomic version swap.
+
+        The candidate checkpoint is fully loaded and manifest-verified OFF
+        the serving path (:func:`load_tables` with ``verify=True``), then
+        normalized into dense planes, and only then swapped in under the
+        servant lock with a version bump — a corrupt newer checkpoint is
+        rejected here (``CheckpointError``) while the live tables keep
+        serving the old version untouched. ``retry`` (a
+        :class:`~swiftsnails_tpu.resilience.retry.RetryPolicy`) absorbs
+        transient storage errors during the shadow load."""
+        from swiftsnails_tpu.framework.checkpoint import load_tables
+
+        try:
+            state, manifest = load_tables(
+                root, step=step, verify=True, retry=retry)
+            tables, dense, _ = _normalize_state_tables(
+                state, config, self.scorer, self.mesh)
+        except Exception as e:
+            self.registry.counter("serve.reload_rejected").inc()
+            if self.ledger is not None:
+                try:
+                    self.ledger.append("cache_error", {
+                        "source": "serve_reload",
+                        "root": root,
+                        "step": step,
+                        "kept_version": self.version,
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                except Exception:
+                    pass
+            raise
+        return self.reload(tables, manifest=manifest, dense=dense)
+
     def close(self) -> None:
         for b in self._batchers.values():
             b.close()
@@ -513,19 +613,40 @@ class Servant:
     # -- request API -------------------------------------------------------
 
     def pull(self, ids, table: Optional[str] = None) -> np.ndarray:
-        """[N] row ids -> [N, dim] rows (cache -> micro-batch -> kernel)."""
+        """[N] row ids -> [N, dim] rows (cache -> micro-batch -> kernel).
+
+        Availability ladder: fresh cache hits and a healthy dispatch serve
+        normally; an open pull breaker — or a dispatch failure — falls back
+        to the stale hot-row LRU when every id is present (a DEGRADED serve,
+        counted apart from the fresh path); otherwise the typed error
+        propagates (:class:`Unavailable` when the breaker shed it)."""
         t0 = time.perf_counter()
         name = table or self.default_table
         ids = np.asarray(ids, np.int32).reshape(-1)
         version = self.version
         found, missing = self.cache.get_many(name, version, ids)
         if missing:
-            req = self._batchers["pull"].submit(
-                {"table": name, "ids": np.asarray(missing, np.int32),
-                 "version": version},
-                n=len(missing),
-            )
-            pulled = _wait(req)  # [len(missing), dim]
+            br = self.breakers.get("pull")
+            if br is not None and not br.allow():
+                return self._pull_degraded(name, ids, t0, reason="open")
+            try:
+                req = self._batchers["pull"].submit(
+                    {"table": name, "ids": np.asarray(missing, np.int32),
+                     "version": version},
+                    n=len(missing),
+                )
+                pulled = _wait(req)  # [len(missing), dim]
+            except Overloaded:
+                raise  # queue pressure, not kernel health
+            except Exception:
+                if br is not None:
+                    br.record_failure()
+                if self.degraded_enabled:
+                    return self._pull_degraded(
+                        name, ids, t0, reason="dispatch_failure")
+                raise
+            if br is not None:
+                br.record_success()
             found.update(
                 (int(i), pulled[n]) for n, i in enumerate(missing)
             )
@@ -533,6 +654,25 @@ class Servant:
             np.zeros((0,) + self._tables[name].shape[1:], np.float32)
         self._observe("pull", t0, units=len(ids))
         return out
+
+    def _pull_degraded(self, name: str, ids: np.ndarray, t0: float,
+                       reason: str) -> np.ndarray:
+        """Serve a pull from the stale hot-row LRU, or shed. Only complete
+        answers are served — a partially-stale response would silently mix
+        row generations within one request."""
+        if self.degraded_enabled:
+            found, missing = self.cache.get_stale(name, ids)
+            if not missing:
+                self._note_degraded("pull", len(ids), reason)
+                self._observe("pull", t0, units=len(ids))
+                return np.stack([found[int(i)] for i in ids]) if len(ids) \
+                    else np.zeros(
+                        (0,) + self._tables[name].shape[1:], np.float32)
+            detail = f"{len(missing)}/{len(ids)} id(s) not in the stale cache"
+        else:
+            detail = "degraded reads disabled (serve_degraded: 0)"
+        self.registry.counter("serve.pull.unavailable").inc()
+        raise Unavailable(f"pull[{name}]: breaker {reason}; {detail}")
 
     def topk(
         self,
@@ -551,12 +691,12 @@ class Servant:
         name = table or self.default_table
         k = int(k or self.topk_default)
         q = np.asarray(query, np.float32).reshape(1, -1)
-        req = self._batchers["topk"].submit(
+        scores, ids = self._guarded_dispatch(
+            "topk",
             {"table": name, "queries": q, "k": k + len(exclude),
              "normalize": normalize},
             n=1,
-        )
-        scores, ids = _wait(req)  # ([1, k+x], [1, k+x])
+        )  # ([1, k+x], [1, k+x])
         out = [
             (int(i), float(s))
             for i, s in zip(ids[0], scores[0])
@@ -573,14 +713,47 @@ class Servant:
         feats = np.asarray(feats, np.int32)
         if feats.ndim == 1:
             feats = feats[None, :]
-        req = self._batchers["score"].submit({"feats": feats}, n=len(feats))
-        out = _wait(req)
+        out = self._guarded_dispatch("score", {"feats": feats}, n=len(feats))
         self._observe("score", t0, units=len(feats))
         return out
 
+    def _guarded_dispatch(self, kernel: str, payload: Dict, n: int):
+        """Submit + wait under the kernel's breaker. ``topk``/``score`` have
+        no row cache to degrade from: an open breaker sheds with a typed
+        :class:`Unavailable`; dispatch failures feed the breaker and
+        propagate."""
+        br = self.breakers.get(kernel)
+        if br is not None and not br.allow():
+            self.registry.counter(f"serve.{kernel}.unavailable").inc()
+            raise Unavailable(f"{kernel}: breaker open; request shed")
+        try:
+            result = _wait(self._batchers[kernel].submit(payload, n=n))
+        except Overloaded:
+            raise  # queue pressure, not kernel health
+        except Exception:
+            if br is not None:
+                br.record_failure()
+            raise
+        if br is not None:
+            br.record_success()
+        return result
+
     # -- dispatch (batcher thread) ----------------------------------------
 
+    def _maybe_fault(self, kernel: str) -> None:
+        """Chaos injection point, once per dispatched batch: the hook may
+        raise (``serve_io_error``) or stall (``serve_slow``) exactly where a
+        sick storage/device read would. No-op (one attribute load) when no
+        hook is installed."""
+        hook = self.fault_hook
+        if hook is None:
+            return
+        idx = self._dispatch_seq[kernel]
+        self._dispatch_seq[kernel] = idx + 1
+        hook(kernel, idx)
+
     def _dispatch_pull(self, batch: List[_Request]) -> None:
+        self._maybe_fault("pull")
         by_table: Dict[str, List[_Request]] = {}
         for req in batch:
             by_table.setdefault(req.payload["table"], []).append(req)
@@ -624,6 +797,7 @@ class Servant:
             (0, table.shape[1]), np.float32)
 
     def _dispatch_topk(self, batch: List[_Request]) -> None:
+        self._maybe_fault("topk")
         by_key: Dict[Tuple[str, int, bool], List[_Request]] = {}
         for req in batch:
             p = req.payload
@@ -689,6 +863,7 @@ class Servant:
         return np.asarray(jax.nn.sigmoid(logits))
 
     def _dispatch_score(self, batch: List[_Request]) -> None:
+        self._maybe_fault("score")
         table = self._tables[self.default_table]
         feats = np.concatenate([r.payload["feats"] for r in batch])
         cap = self.buckets[-1]
@@ -723,6 +898,45 @@ class Servant:
         self._latency[kernel].append(ms)
         self.registry.histogram(f"serve.{kernel}.latency_ms").observe(ms)
         self.registry.counter(f"serve.{kernel}.requests").inc()
+
+    def _on_breaker_transition(self, kernel: str, old: str, new: str,
+                               snapshot: Dict) -> None:
+        """Every breaker state change is observable: a counter bump plus a
+        structured ``breaker`` ledger event (trip AND recovery — the failure
+        timeline should show both edges)."""
+        self.registry.counter(f"serve.{kernel}.breaker_{new}").inc()
+        if self.ledger is not None:
+            try:
+                self.ledger.append("breaker", {
+                    "source": "serving",
+                    "kernel": kernel,
+                    "from": old,
+                    "to": new,
+                    **{k: snapshot[k] for k in
+                       ("consecutive_failures", "threshold", "trips",
+                        "recoveries", "last_recovery_latency_ms")},
+                })
+            except Exception:
+                pass  # record-keeping never blocks the serve path
+
+    def _note_degraded(self, kernel: str, rows: int, reason: str) -> None:
+        """Count a degraded (stale-LRU) serve — a separate ledger/metric
+        stream from the fresh counters, rate-limited like overloads."""
+        self.registry.counter(f"serve.{kernel}.degraded").inc()
+        self.registry.counter("serve.degraded_hits").inc(rows)
+        total = int(self.registry.counter(f"serve.{kernel}.degraded").value)
+        if self.ledger is not None and (total == 1 or total % 100 == 0):
+            try:
+                self.ledger.append("degraded", {
+                    "source": "serving",
+                    "kernel": kernel,
+                    "reason": reason,
+                    "rows": rows,
+                    "degraded_total": total,
+                })
+                self._degraded_events = total
+            except Exception:
+                pass
 
     def _note_shed(self, kernel: str) -> None:
         self.registry.counter(f"serve.{kernel}.shed").inc()
@@ -791,6 +1005,17 @@ class Servant:
                 k: int(reg.counter(f"serve.{k}.pad_rows").value)
                 for k in ("pull", "topk", "score")
             },
+            "breakers": {k: br.snapshot() for k, br in self.breakers.items()},
+            "degraded": {
+                "enabled": self.degraded_enabled,
+                "hits": int(reg.counter("serve.degraded_hits").value),
+                **{k: int(reg.counter(f"serve.{k}.degraded").value)
+                   for k in ("pull", "topk", "score")},
+            },
+            "unavailable": {
+                k: int(reg.counter(f"serve.{k}.unavailable").value)
+                for k in ("pull", "topk", "score")
+            },
             **({"tiered": {
                 **self._tier_stats.as_dict(),
                 "tables": {
@@ -800,6 +1025,33 @@ class Servant:
                 },
             }} if self.tier else {}),
         }
+
+    def health(self) -> Dict:
+        """One-call liveness/availability report: overall ``status`` is
+        ``"ok"`` when every breaker is closed, ``"degraded"`` otherwise —
+        the Servant keeps answering in both cases, the caller just learns
+        whether answers may be stale or shed."""
+        reg = self.registry
+        states = {k: br.state for k, br in self.breakers.items()}
+        status = "ok" if all(s == CLOSED for s in states.values()) else "degraded"
+        out = {
+            "status": status,
+            "version": self.version,
+            "step": self.step,
+            "tables": {k: list(v.shape) for k, v in self._tables.items()},
+            "breakers": {k: br.snapshot() for k, br in self.breakers.items()},
+            "degraded_enabled": self.degraded_enabled,
+            "degraded_hits": int(reg.counter("serve.degraded_hits").value),
+            "shed_total": self.shed_count(),
+        }
+        if self.tier:
+            out["tier"] = {
+                name: {"budget_slots": tt.budget,
+                       "master_units": tt.master.units,
+                       "resident": int((tt.unit_of >= 0).sum())}
+                for name, tt in self.tier.items()
+            }
+        return out
 
 
 def _int_list(raw: str, default: Sequence[int]) -> Tuple[int, ...]:

@@ -448,7 +448,8 @@ def render_report(ledger: Ledger) -> str:
 # failure-timeline view: every kind that marks something going wrong (or a
 # chaos drill making it go wrong on purpose), interleaved with run records
 # for context — `ledger-report --failures`
-FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload")
+FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
+                 "retry_exhausted", "breaker", "degraded")
 
 
 def _failure_line(r: Dict) -> str:
@@ -483,6 +484,28 @@ def _failure_line(r: Dict) -> str:
             f"  {ts}  OVERLOAD kernel={r.get('kernel')} "
             f"shed_total={r.get('shed_total')} "
             f"queue_depth={r.get('queue_depth')}"
+        )
+    if kind == "retry_exhausted":
+        return (
+            f"  {ts}  RETRY-EXHAUSTED op={r.get('op')} "
+            f"attempts={r.get('attempts')} "
+            f"elapsed={_fmt_num(r.get('elapsed_ms', 0))}ms "
+            f"reason={r.get('reason')}  {str(r.get('error', ''))[:70]}"
+        )
+    if kind == "breaker":
+        snap = ""
+        if r.get("to") == "closed" and r.get("last_recovery_latency_ms"):
+            snap = f"  recovered_in={r['last_recovery_latency_ms']}ms"
+        return (
+            f"  {ts}  BREAKER  kernel={r.get('kernel')} "
+            f"{r.get('from')}->{r.get('to')} "
+            f"trips={r.get('trips')}{snap}"
+        )
+    if kind == "degraded":
+        return (
+            f"  {ts}  DEGRADED kernel={r.get('kernel')} "
+            f"reason={r.get('reason')} rows={r.get('rows')} "
+            f"total={r.get('degraded_total')}"
         )
     return f"  {ts}  {kind}"
 
@@ -520,6 +543,15 @@ def render_failures(ledger: Ledger) -> str:
                 f"recovered_all={c.get('recovered_all')} "
                 f"guard_overhead={c.get('guard_overhead_pct')}% "
                 f"loss_parity={c.get('loss_parity')}"
+            )
+        elif kind == "bench" and isinstance(r.get("payload"), dict) \
+                and isinstance(r["payload"].get("chaos_serve"), dict):
+            c = r["payload"]["chaos_serve"]
+            lines.append(
+                f"  {r.get('ts', '?')}  bench    chaos-serve lane: "
+                f"availability={c.get('availability_pct')}% "
+                f"degraded_share={c.get('degraded_share_pct')}% "
+                f"p99_under_fault={c.get('p99_under_fault_ms')}ms"
             )
     if shown == 0:
         lines.append("  (no failure events recorded)")
@@ -562,7 +594,10 @@ def check_regression(
         t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
         if t_msg:
             msg = f"{msg}\n{t_msg}"
-        return max(2, c_rc, v_rc, t_rc), msg
+        a_rc, a_msg = _check_chaos_serve_regression(ledger)
+        if a_msg:
+            msg = f"{msg}\n{a_msg}"
+        return max(2, c_rc, v_rc, t_rc, a_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -581,7 +616,10 @@ def check_regression(
             t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
             if t_msg:
                 msg = f"{msg}\n{t_msg}"
-            return max(0, c_rc, v_rc, t_rc), msg
+            a_rc, a_msg = _check_chaos_serve_regression(ledger)
+            if a_msg:
+                msg = f"{msg}\n{a_msg}"
+            return max(0, c_rc, v_rc, t_rc, a_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -607,7 +645,10 @@ def check_regression(
     t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
     if t_msg:
         msg = f"{msg}\n{t_msg}"
-    return max(rc, s_rc, c_rc, v_rc, t_rc), msg
+    a_rc, a_msg = _check_chaos_serve_regression(ledger)
+    if a_msg:
+        msg = f"{msg}\n{a_msg}"
+    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -688,6 +729,46 @@ def _check_chaos_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
     return 0, (
         f"chaos ok: all drills recovered, guard overhead "
         f"{c.get('guard_overhead_pct')}%, resume loss parity {parity}"
+    )
+
+
+def _check_chaos_serve_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the chaos-serve lane's *availability* alongside the perf
+    headline: the newest bench record carrying a ``chaos_serve`` block (any
+    platform — availability under fault is correctness, so CPU lane runs
+    count) must hold the lane's availability floor, prove the unprotected
+    control actually hard-fails, and reject the corrupt-reload drill. No
+    chaos-serve history gates nothing."""
+    with_cs = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("chaos_serve"), dict)
+    ]
+    if not with_cs:
+        return 0, None
+    c = with_cs[-1]["payload"]["chaos_serve"]
+    avail = c.get("availability_pct")
+    floor = c.get("floor_pct", 99.0)
+    problems = []
+    if not (isinstance(avail, (int, float)) and avail >= floor):
+        problems.append(
+            f"availability {avail}% under fault is below the "
+            f"{floor}% floor")
+    if not c.get("unprotected_hard_failure", True):
+        problems.append(
+            "breakers-off control leg did NOT hard-fail (fault matrix "
+            "is not exercising the serve path)")
+    if not c.get("reload_corrupt_rejected", True):
+        problems.append("corrupt-reload drill was not rejected")
+    if c.get("tier_bitflip") is not None and not (
+            c["tier_bitflip"] or {}).get("recovered"):
+        problems.append("tier_bitflip drill did not recover")
+    if problems:
+        return 1, "chaos-serve REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"chaos-serve ok: availability {avail:.2f}% (floor {floor}%), "
+        f"degraded share {c.get('degraded_share_pct')}%, "
+        f"p99 under fault {c.get('p99_under_fault_ms')}ms"
     )
 
 

@@ -49,6 +49,12 @@ class TierManager:
         if self.budget_mb <= 0:
             raise ConfigError("tier_hbm_budget_mb must be > 0")
         self.prefetch_depth = cfg.get_int("tier_prefetch_depth", 2)
+        self.checksums = cfg.get_bool("tier_checksums", True)
+        from swiftsnails_tpu.resilience.retry import RetryPolicy
+
+        # shared policy over the tier's fallible host I/O (master flush at
+        # checkpoint/end-of-run, heal-time checkpoint restore)
+        self.retry = RetryPolicy.from_config(cfg)
         self.registry = registry
         self.stats = TierStats()
         self.tables: Dict[str, TieredTable] = {}
@@ -64,7 +70,8 @@ class TierManager:
         for name, st in tabs.items():
             info = self.spec[name]
             master = HostMaster(
-                st, info["layout"], group=int(info.get("group", 1)))
+                st, info["layout"], group=int(info.get("group", 1)),
+                checksums=self.checksums)
             units = int(budget_each * (1 << 20) // max(master.unit_nbytes, 1))
             tt = TieredTable(
                 master, units, mesh=self.trainer.mesh, name=name,
@@ -170,9 +177,71 @@ class TierManager:
         happens *before* the caller builds any checkpoint manifest."""
         tabs = self.trainer.tier_tables(state)
         for name, tt in self.tables.items():
-            tt.flush(tabs[name])
+            self.retry.call(tt.flush, tabs[name], op=f"tier_flush:{name}")
         masters = {name: tt.master.state() for name, tt in self.tables.items()}
         return self.trainer.tier_with_tables(state, masters)
+
+    # -- integrity: verify / quarantine-and-rebuild ---------------------------
+
+    def verify(self) -> Dict[str, list]:
+        """Recompute every master plane digest; returns ``{table: [corrupt
+        plane, ...]}`` for the tables that fail (empty dict = all intact)."""
+        bad = {}
+        for name, tt in self.tables.items():
+            planes = tt.master.verify()
+            if planes:
+                bad[name] = planes
+        return bad
+
+    def heal(self, state, root: str, corrupt: Optional[Dict[str, list]] = None,
+             retry_policy=None):
+        """Quarantine-and-rebuild: replace each corrupt table's master planes
+        from the newest *verified* checkpoint under ``root``, then write every
+        currently-resident cache slot back on top — the cache plane was never
+        corrupt (the flip hit host memory), so re-asserting it bounds the
+        rollback to units evicted since that checkpoint.
+
+        Returns ``(step, rebuilt_tables)``; raises
+        :class:`~swiftsnails_tpu.framework.checkpoint.CheckpointError` when no
+        verified checkpoint survives (there is nothing trustworthy to rebuild
+        from — training on a silently-corrupt master would be worse than
+        dying)."""
+        from swiftsnails_tpu.framework.checkpoint import (
+            CheckpointError, candidate_steps, restore_checkpoint,
+        )
+
+        corrupt = self.verify() if corrupt is None else corrupt
+        if not corrupt:
+            return None, []
+        # full-size template: shapes/dtypes for the template-driven restore.
+        # The (corrupt) content is irrelevant — only the structure is read.
+        masters = {name: tt.master.state() for name, tt in self.tables.items()}
+        template = self.trainer.tier_with_tables(state, masters)
+
+        def _restore_newest_verified():
+            rejections = []
+            for s in candidate_steps(root):
+                try:
+                    return s, restore_checkpoint(
+                        root, template, step=s, verify=True)
+                except Exception as e:
+                    rejections.append(f"step_{s}: {type(e).__name__}: {e}")
+            raise CheckpointError(
+                f"tier heal: no verified checkpoint under {root!r}: "
+                + " | ".join(rejections[:4]))
+
+        policy = retry_policy if retry_policy is not None else self.retry
+        step, restored = policy.call(
+            _restore_newest_verified, op="tier_heal_restore")
+        restored_tabs = self.trainer.tier_tables(restored)
+        tabs = self.trainer.tier_tables(state)
+        rebuilt = []
+        for name in corrupt:
+            tt = self.tables[name]
+            tt.master.reload(restored_tabs[name])
+            tt.writeback_resident(tabs[name])
+            rebuilt.append(name)
+        return step, rebuilt
 
     def summary(self) -> Dict:
         out = self.stats.as_dict()

@@ -86,13 +86,40 @@ def _pow2(n: int) -> int:
     return p
 
 
+_MASK64 = (1 << 64) - 1
+_HASH_SEED = 0x5EED5A11  # fixed: digests are process-local, any constant works
+
+
+def _hash_weights(nbytes: int, seed: int) -> np.ndarray:
+    """Fixed pseudo-random odd uint64 weight per byte position — the key of
+    the per-unit hash. Odd weights make every byte position full-rank mod
+    2^64, so any single flipped bit flips the unit hash."""
+    rng = np.random.default_rng(seed)
+    lo = rng.integers(0, 1 << 32, size=nbytes, dtype=np.uint64)
+    hi = rng.integers(0, 1 << 32, size=nbytes, dtype=np.uint64)
+    return (hi << np.uint64(32)) | lo | np.uint64(1)
+
+
+def _rows_hash(rows: np.ndarray, weights: np.ndarray) -> int:
+    """Wraparound-sum keyed hash of a block of units: view each unit's bytes
+    as uint8, weight by position, sum everything mod 2^64. Per-unit hashes
+    are summed (not chained), so a plane digest updates incrementally —
+    subtract the old units' hashes, add the new ones."""
+    n = rows.shape[0]
+    if n == 0:
+        return 0
+    flat = np.ascontiguousarray(rows).view(np.uint8).reshape(n, -1)
+    return int((flat.astype(np.uint64) * weights).sum(dtype=np.uint64))
+
+
 class HostMaster:
     """NumPy master plane for one table: the same (table, slots) leaves as
     the device state, full size, host-resident. ``group`` is the number of
     logical rows per cache unit (1 except the packed-small plane, where one
     unit is a ``[S, 128]`` tile holding G rows)."""
 
-    def __init__(self, state, layout: str, group: int = 1):
+    def __init__(self, state, layout: str, group: int = 1,
+                 checksums: bool = True):
         self.kind = type(state)  # TableState | PackedTableState
         self.layout = layout
         self.group = int(group)
@@ -102,6 +129,79 @@ class HostMaster:
         self.slots = {
             k: np.array(jax.device_get(v)) for k, v in state.slots.items()
         }
+        # per-plane integrity digests: a keyed wraparound sum of per-unit
+        # hashes, maintained incrementally through scatter() so a direct
+        # memory corruption (bit rot, a stray write bypassing scatter) is
+        # detectable by verify() at any time
+        self._weights: Optional[Dict[str, np.ndarray]] = None
+        self._digests: Optional[Dict[str, int]] = None
+        if checksums:
+            self._init_digests()
+
+    # -- integrity ----------------------------------------------------------
+
+    def _planes(self):
+        yield "table", self.table
+        for k in sorted(self.slots):
+            yield f"slots/{k}", self.slots[k]
+
+    def _plane_weights(self, plane: str, arr: np.ndarray) -> np.ndarray:
+        per = int(np.prod(arr.shape[1:], dtype=np.int64)) * arr.dtype.itemsize
+        w = self._weights.get(plane)
+        if w is None or w.shape[0] != per:
+            seed = (_HASH_SEED + hash(plane)) & _MASK64
+            w = self._weights[plane] = _hash_weights(max(per, 1), seed)
+        return w
+
+    def _plane_digest(self, plane: str, arr: np.ndarray,
+                      chunk: int = 8192) -> int:
+        w = self._plane_weights(plane, arr)
+        total = 0
+        for start in range(0, arr.shape[0], chunk):
+            total = (total + _rows_hash(arr[start:start + chunk], w)) & _MASK64
+        return total
+
+    def _init_digests(self) -> None:
+        self._weights = {}
+        self._digests = {
+            plane: self._plane_digest(plane, arr)
+            for plane, arr in self._planes()
+        }
+
+    @property
+    def checksummed(self) -> bool:
+        return self._digests is not None
+
+    def _digest_swap(self, plane: str, arr: np.ndarray, units: np.ndarray,
+                     old_rows: np.ndarray, new_rows: np.ndarray) -> None:
+        w = self._plane_weights(plane, arr)
+        d = self._digests[plane]
+        d = (d - _rows_hash(old_rows, w)) & _MASK64
+        d = (d + _rows_hash(np.asarray(new_rows, dtype=arr.dtype), w)) & _MASK64
+        self._digests[plane] = d
+
+    def verify(self) -> list:
+        """Recompute every plane digest and compare with the incrementally
+        tracked one; returns the names of corrupt planes (``table`` /
+        ``slots/<name>``), empty when the masters are intact. Any content
+        change that did not flow through :meth:`scatter` — a flipped bit, a
+        torn write — shows up here."""
+        if self._digests is None:
+            return []
+        return [
+            plane for plane, arr in self._planes()
+            if self._plane_digest(plane, arr) != self._digests[plane]
+        ]
+
+    def reload(self, state) -> None:
+        """Replace the master content wholesale (quarantine-and-rebuild path:
+        the caller restored a verified checkpoint) and re-seed the digests."""
+        tab = state["table"] if isinstance(state, dict) else state.table
+        slots = state["slots"] if isinstance(state, dict) else state.slots
+        self.table = np.array(jax.device_get(tab))
+        self.slots = {k: np.array(jax.device_get(v)) for k, v in slots.items()}
+        if self._digests is not None:
+            self._init_digests()
 
     @property
     def units(self) -> int:
@@ -121,6 +221,16 @@ class HostMaster:
 
     def scatter(self, units: np.ndarray, table_rows: np.ndarray,
                 slot_rows: Dict[str, np.ndarray]) -> None:
+        """Write units back into the masters. ``units`` must be unique (every
+        call site flushes a slot map, which is injective) — the incremental
+        digest update assumes each unit's old bytes are replaced once."""
+        units = np.asarray(units)
+        if self._digests is not None and units.size:
+            self._digest_swap("table", self.table, units,
+                              self.table[units], table_rows)
+            for k, v in slot_rows.items():
+                self._digest_swap(f"slots/{k}", self.slots[k], units,
+                                  self.slots[k][units], v)
         self.table[units] = table_rows
         for k, v in slot_rows.items():
             self.slots[k][units] = v
@@ -408,6 +518,18 @@ class TieredTable:
         d = np.nonzero(self.dirty)[0]
         if d.size:
             self._flush_slots(cache, d)
+
+    def writeback_resident(self, cache) -> int:
+        """Write EVERY resident slot back to the master, dirty or not — the
+        quarantine-and-rebuild path: after the master plane is reloaded from
+        an (older) verified checkpoint, the cache is the authoritative copy
+        of everything currently resident, so re-asserting it narrows the
+        rollback to units that were evicted since that checkpoint. Returns
+        the number of units written."""
+        r = np.nonzero(self.unit_of >= 0)[0]
+        if r.size:
+            self._flush_slots(cache, r)
+        return int(r.size)
 
     # -- admission seeding ----------------------------------------------------
 

@@ -13,6 +13,15 @@ matches an undisturbed one.
     python tools/chaos_drill.py            # the full matrix
     python tools/chaos_drill.py --fast     # the tier-1 subset
     python tools/chaos_drill.py --json     # machine-readable results
+    python tools/chaos_drill.py --serve    # the serving availability matrix
+
+``--serve`` runs the CPU-valid availability drill instead (the bench
+``chaos-serve`` lane): a seeded fault matrix against a live Servant with
+circuit breakers + degraded stale-LRU reads must hold the availability
+floor while the unprotected control leg hard-fails, a corrupt checkpoint
+must be rejected by the shadow-verify reload, and the tiered bit-flip
+drill must detect + rebuild with loss parity. Exit is nonzero on a missed
+floor or any failed drill.
 
 Every injection and every recovery event lands in the drill's own ledger
 (``<workdir>/<drill>/LEDGER.jsonl``); inspect one with
@@ -32,6 +41,41 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _serve_matrix(args) -> int:
+    from swiftsnails_tpu.serving.chaos_lane import chaos_serve_bench
+
+    res = chaos_serve_bench(small=True, workdir=args.workdir)
+    tier = res.get("tier_bitflip") or {}
+    checks = {
+        "availability_floor": res["availability_pct"] >= res["floor_pct"],
+        "unprotected_hard_failure": bool(res["unprotected_hard_failure"]),
+        "reload_corrupt_rejected": bool(res["reload_corrupt_rejected"]),
+        "tier_bitflip_recovered": bool(tier.get("recovered", True)),
+    }
+    failed = [k for k, ok in checks.items() if not ok]
+    if args.json:
+        print(json.dumps({"chaos_serve": res, "checks": checks,
+                          "failed": failed}))
+    else:
+        print(f"availability        {res['availability_pct']:.1f}% "
+              f"(floor {res['floor_pct']:.1f}%, "
+              f"degraded share {res['degraded_share_pct']:.1f}%)")
+        print(f"p99 under fault     {res['p99_under_fault_ms']} ms "
+              f"(trip {res['trip_ms']} ms, recover {res['recover_ms']} ms)")
+        print(f"control leg         {res['control_availability_pct']:.1f}% "
+              f"hard_failure={res['unprotected_hard_failure']} "
+              f"({res['control_first_error']})")
+        print(f"reload_corrupt      rejected={res['reload_corrupt_rejected']}")
+        if tier:
+            print(f"tier_bitflip        recovered={tier.get('recovered')} "
+                  f"parity={tier.get('loss_parity')}")
+        for name, ok in checks.items():
+            print(f"{name:<26}  {'PASS' if ok else 'FAIL'}")
+        print("serve matrix "
+              + ("PASSED" if not failed else f"FAILED: {', '.join(failed)}"))
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos_drill",
@@ -43,7 +87,14 @@ def main(argv=None) -> int:
                    help="emit one JSON object instead of the table")
     p.add_argument("--workdir", default=None,
                    help="keep drill artifacts (ledgers, checkpoints) here")
+    p.add_argument("--serve", action="store_true",
+                   help="run the serving availability matrix instead "
+                        "(breakers + degraded reads vs the fault schedule; "
+                        "nonzero exit on a missed availability floor)")
     args = p.parse_args(argv)
+
+    if args.serve:
+        return _serve_matrix(args)
 
     from swiftsnails_tpu.resilience.drill import run_drill_matrix
 
