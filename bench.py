@@ -130,7 +130,8 @@ _state = {
     "serving": None,  # read-path latency lane (dict; see --lane serve)
     "tiered": None,  # host-tier parameter store lane (dict; see --lane tiered)
     "chaos_serve": None,  # serving availability drill (dict; --lane chaos-serve)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve)
+    "chaos_cluster": None,  # cluster membership drill (dict; --lane chaos-cluster)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -240,6 +241,7 @@ def _result_json(extra_error=None):
             "serving": _state["serving"],
             "tiered": _state["tiered"],
             "chaos_serve": _state["chaos_serve"],
+            "chaos_cluster": _state["chaos_cluster"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1263,6 +1265,65 @@ def run_chaos_serve_lane() -> int:
     return 0 if ok else 1
 
 
+# -- chaos-cluster (membership drill) lane --------------------------------------
+#
+# `--lane chaos-cluster` runs the cluster supervisor drill (`swiftsnails_tpu/
+# cluster/chaos_lane.py`): a virtual-clock simulated fleet under a seeded
+# membership storm (silent worker death + straggler window + partition),
+# once supervised (lease expiry -> elastic reassignment; the exactly-once
+# batch-accounting ledger must prove 0 lost / 0 double-applied and loss must
+# stay within parity of an undisturbed in-order control) and once with the
+# supervisor off (the same storm must demonstrably lose the dead worker's
+# range). Membership correctness is platform-independent, so the lane is
+# valid on CPU; the block lands in the result JSON (`chaos_cluster`), the
+# run ledger, and the `ledger-report --check-regression` gate.
+
+
+def measure_chaos_cluster() -> None:
+    """Populate ``_state['chaos_cluster']`` with the membership-drill block."""
+    from swiftsnails_tpu.cluster.chaos_lane import chaos_cluster_bench
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    block = chaos_cluster_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["chaos_cluster"] = block
+    print(
+        f"bench: chaos-cluster lane: {block.get('committed')}/"
+        f"{block.get('total_batches')} exactly-once "
+        f"(lost {block.get('lost_count')}, dup {block.get('duplicated_count')}, "
+        f"dup_discarded {block.get('dup_discarded')}) "
+        f"workers_lost {block.get('workers_lost')} "
+        f"reassignments {block.get('reassignments')} "
+        f"loss parity {block.get('loss_parity')} "
+        f"control hard-failure {block.get('unprotected_hard_failure')}",
+        file=sys.stderr,
+    )
+
+
+def run_chaos_cluster_lane() -> int:
+    """``--lane chaos-cluster``: the membership drill alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "chaos-cluster"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_chaos_cluster()
+    except Exception as e:
+        _state["errors"].append(
+            f"chaos-cluster lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["chaos_cluster"]
+    # the lane's headline is exactly-once recovery, not a rate — leave the
+    # perf headline empty and gate on the drill's own recovery verdict
+    _state["best_path"] = "chaos-cluster"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    return 0 if block.get("recovered") else 1
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -1615,7 +1676,9 @@ def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="bench", description="word2vec words/sec/chip benchmark")
     parser.add_argument(
-        "--lane", choices=("full", "chaos", "serve", "tiered", "chaos-serve"),
+        "--lane",
+        choices=("full", "chaos", "serve", "tiered", "chaos-serve",
+                 "chaos-cluster"),
         default="full",
         help="full = the headline bench (default); chaos = the resilience "
              "lane alone (guardrail overhead + scripted-fault recovery "
@@ -1625,7 +1688,10 @@ def main(argv=None):
              "resident + over-budget round trip; valid on CPU); chaos-serve "
              "= the serving availability drill (fault matrix vs a live "
              "Servant with breakers + degraded reads, corrupt-reload and "
-             "tier bit-flip drills; valid on CPU)",
+             "tier bit-flip drills; valid on CPU); chaos-cluster = the "
+             "cluster membership drill (simulated fleet under a kill/"
+             "straggle/partition storm; exactly-once accounting + elastic "
+             "reassignment vs an unsupervised control; valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -1639,6 +1705,8 @@ def main(argv=None):
         return run_tiered_lane()
     if args.lane == "chaos-serve":
         return run_chaos_serve_lane()
+    if args.lane == "chaos-cluster":
+        return run_chaos_cluster_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

@@ -17,6 +17,7 @@ Usage::
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
     python -m swiftsnails_tpu ledger-report --check-regression 10   # bench gate
     python -m swiftsnails_tpu ledger-report --failures   # outage/chaos timeline
+    python -m swiftsnails_tpu supervisor-status [LEDGER.jsonl]   # membership view
     python -m swiftsnails_tpu worker -config ...   # alias of train (parity)
 
 Resilience (docs/RESILIENCE.md): ``resume: auto`` continues an interrupted
@@ -197,6 +198,26 @@ def cmd_ledger_report(argv: List[str]) -> int:
     return ledger_main(argv)
 
 
+def cmd_supervisor_status(argv: List[str]) -> int:
+    """Replay a run ledger's membership events into the supervisor's view:
+    per-worker state (alive/lost, joins, straggler flags, where reassigned
+    ranges went) plus the newest exactly-once accounting verdict."""
+    import os
+
+    from swiftsnails_tpu.cluster.status import render_supervisor_status
+    from swiftsnails_tpu.telemetry.ledger import DEFAULT_LEDGER, Ledger
+
+    path = argv[0] if argv else os.environ.get("SSN_LEDGER_PATH",
+                                               DEFAULT_LEDGER)
+    ledger = Ledger(path)
+    if not os.path.exists(ledger.path):
+        print(f"supervisor-status: no ledger at {ledger.path}",
+              file=sys.stderr)
+        return 1
+    print(render_supervisor_status(ledger))
+    return 0
+
+
 _ROLE_NOTE = (
     "swiftsnails_tpu has no separate {role} role: the parameter table lives\n"
     "sharded across the same TPU processes that train. Run\n"
@@ -230,12 +251,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_trace_summary(rest)
         if cmd == "ledger-report":
             return cmd_ledger_report(rest)
+        if cmd == "supervisor-status":
+            return cmd_supervisor_status(rest)
         if cmd in ("master", "server"):
             print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
             return 0
         print(
             f"unknown command {cmd!r}; try: train, export, serve, models, "
-            "trace-summary, ledger-report",
+            "trace-summary, ledger-report, supervisor-status",
             file=sys.stderr,
         )
         return 2

@@ -1,0 +1,31 @@
+"""Cluster supervision: the reference Master role (rendezvous + lifecycle)
+reproduced as lease-based membership, straggler mitigation, and elastic
+data-shard reassignment with exactly-once batch accounting.
+
+See docs/CLUSTER.md for the lease/watermark protocol and the drill
+cookbook.
+"""
+
+from swiftsnails_tpu.cluster.accounting import (
+    BatchAccountant, RangeLease, compress_ranges, expand_ranges,
+)
+from swiftsnails_tpu.cluster.supervisor import (
+    STRAGGLER_FACTOR, STRAGGLER_SHARE, Supervisor, WorkerLost,
+)
+from swiftsnails_tpu.cluster.worker import (
+    IndexedBatchSource, LeasedStream, WorkerClient,
+)
+
+__all__ = [
+    "BatchAccountant",
+    "RangeLease",
+    "compress_ranges",
+    "expand_ranges",
+    "Supervisor",
+    "WorkerLost",
+    "STRAGGLER_FACTOR",
+    "STRAGGLER_SHARE",
+    "IndexedBatchSource",
+    "LeasedStream",
+    "WorkerClient",
+]

@@ -212,6 +212,7 @@ class TrainLoop:
         metrics: Optional[MetricsLogger] = None,
         checkpoint_fn: Optional[Callable[[Any, int], None]] = None,
         log_every: int = 100,
+        cluster=None,
     ):
         self.trainer = trainer
         self.metrics = metrics or MetricsLogger(echo=False)
@@ -235,6 +236,17 @@ class TrainLoop:
             self.ledger = None
         self._restored_step = None  # set by resume; protected from pruning
         self._items_seen = 0
+        # cluster membership: an explicit WorkerClient wins (tests / a shared
+        # in-process supervisor); `cluster_workers: N` self-hosts one — the
+        # run still gets range-leased streams, exactly-once accounting, and a
+        # watermark-carrying checkpoint cursor (see cluster/)
+        self.cluster = cluster
+        if self.cluster is None and cfg.get_int("cluster_workers", 0) > 0:
+            from swiftsnails_tpu.cluster import Supervisor, WorkerClient
+
+            sup = Supervisor.from_config(cfg, ledger=self.ledger)
+            self.cluster = WorkerClient(
+                sup, cfg.get_str("cluster_worker_id", "w0"))
         if checkpoint_fn is None and self.backup_root:
             from swiftsnails_tpu.framework.checkpoint import save_checkpoint
 
@@ -247,9 +259,14 @@ class TrainLoop:
 
             def checkpoint_fn(state, step):
                 ckpt_retry.ledger = self.ledger  # ledger binds below
+                cursor = {"step": step, "items": self._items_seen}
+                if self.cluster is not None:
+                    # committed watermarks ride the data cursor, so resume
+                    # restores exactly-once accounting across reassignment
+                    cursor["cluster"] = self.cluster.cursor()
                 save_checkpoint(
                     self.backup_root, state, step, wait=False,
-                    cursor={"step": step, "items": self._items_seen},
+                    cursor=cursor,
                     config_hash=self.config_hash,
                     keep=self.backup_keep, protect=self._restored_step,
                     ledger=self.ledger, tier=self.tier, retry=ckpt_retry,
@@ -393,6 +410,14 @@ class TrainLoop:
                     # skipping the consumed prefix IS the saved cursor
                     skip_batches = int(cursor.get("step", step) or 0)
                     self._items_seen = int(cursor.get("items", 0) or 0)
+                    if self.cluster is not None:
+                        # restore committed watermarks instead of a flat
+                        # skip: the leased stream's first-writer-wins claims
+                        # skip exactly the committed indices, so a run that
+                        # adopted a reassigned (out-of-order) span replays
+                        # bit-identically
+                        self.cluster.restore(cursor.get("cluster") or {})
+                        skip_batches = 0
         root_rng = jax.random.PRNGKey(seed)
         last_metrics: Dict[str, jax.Array] = {}
         total_items = 0
@@ -403,7 +428,13 @@ class TrainLoop:
             # carries the small cache planes until master_state() at the end
             state = tier.adopt(state)
         depth = trainer.config.get_int("prefetch_batches", 2)
-        src = iter(trainer.batches())
+        cl = self.cluster
+        if cl is not None:
+            # range-leased stream: indices are claimed (first-writer-wins)
+            # as they're yielded and committed at the step boundary below
+            src = iter(cl.leased_stream(trainer.batches))
+        else:
+            src = iter(trainer.batches())
         if tier is not None:
             # stage upcoming steps' plans + missing master rows on the
             # producer thread so the H2D fault traffic overlaps compute
@@ -466,6 +497,11 @@ class TrainLoop:
                                 state, dev_batch, root_rng, np.uint32(step))
                     step += 1
                     self._items_seen += n_items
+                    if cl is not None:
+                        # commit the applied batch + renew the membership
+                        # lease + adopt any reassigned spans — BEFORE a
+                        # checkpoint below, so the cursor sees this commit
+                        cl.on_step(step)
                     self.metrics.count(n_items)
                     if self.log_every and step % self.log_every == 0:
                         host = {k: float(v) for k, v in last_metrics.items()}
@@ -516,6 +552,8 @@ class TrainLoop:
                     step += 1
                     total_items += n_items
                     self._items_seen += n_items
+                    if cl is not None:
+                        cl.on_step(step)
                     reg.counter("steps").inc()
                     reg.counter("items").inc(n_items)
                     step_ms = (time.monotonic() - t_step0) * 1e3

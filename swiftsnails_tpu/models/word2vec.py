@@ -344,22 +344,35 @@ class Word2VecTrainer(Trainer):
             return keys
         return self._rows(keys)
 
-    def _id_cat(self, *parts):
-        """Concatenate row-id vectors; under a mesh, pin the result
-        REPLICATED. GSPMD on this jax line mis-partitions a concatenate of
-        mixed-sharded operands (data-sharded batch lineage vs replicated
-        rng/sample lineage) on a (data, model) mesh: every element arrives
-        multiplied by the model-axis size — silent garbage row ids (the
-        pre-existing grouped-mesh shape-invariance failure). Ids are tiny
-        int32 vectors, so replication costs nothing and the shard_map
-        consumers slice their P(data) shard out of it as before."""
-        out = jnp.concatenate(parts)
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            out = jax.lax.with_sharding_constraint(
-                out, NamedSharding(self.mesh, P()))
+    def _mesh_safe_cat(self, parts):
+        """Leading-axis concatenate that survives GSPMD on a (data, model)
+        mesh. GSPMD on this jax/XLA line assembles a ``concatenate`` of
+        mixed-lineage operands (data-sharded batch lineage vs replicated
+        rng/sample lineage) by dynamic-update-slicing each device's piece
+        into a zero buffer and ALL-REDUCE-SUMMING across the WHOLE mesh —
+        the compiled HLO shows ``all-reduce(replica_groups={all devices},
+        op_name=.../concatenate)``. Along ``model`` the devices hold
+        identical copies, not disjoint slices, so every element arrives
+        multiplied by the model-axis size: silent garbage row ids / scaled
+        gradients (the grouped-mesh shape-invariance breaker). Sharding
+        constraints and optimization barriers on the operands or result do
+        not stop it — the sum IS the lowering of the concat. Expressing the
+        same value as pad-to-length + elementwise add never invokes the
+        concat partitioner, and elementwise ops partition soundly."""
+        if self.mesh is None or len(parts) == 1:
+            return jnp.concatenate(parts)
+        total = sum(p.shape[0] for p in parts)
+        tail = ((0, 0),) * (parts[0].ndim - 1)
+        out, off = None, 0
+        for p in parts:
+            padded = jnp.pad(p, ((off, total - off - p.shape[0]),) + tail)
+            out = padded if out is None else out + padded
+            off += p.shape[0]
         return out
+
+    def _id_cat(self, *parts):
+        """Concatenate row-id vectors (mesh-safe, see _mesh_safe_cat)."""
+        return self._mesh_safe_cat(list(parts))
 
     # packed pull/push dispatch: single-device kernels, or shard_map
     # collectives wrapping the same kernels when a mesh is present
@@ -829,10 +842,11 @@ class Word2VecTrainer(Trainer):
             )
 
         loss, (dv, du, dq) = jax.value_and_grad(loss_of, argnums=(0, 1, 2))(v, u, q)
-        out_grads = jnp.concatenate(
+        # du is data-batch lineage, dq rng-sample lineage: the same
+        # mixed-lineage concat GSPMD mis-assembles (see _mesh_safe_cat)
+        out_grads = self._mesh_safe_cat(
             [du.reshape((n * cw,) + du.shape[2:]),
-             dq.reshape((nb * pn,) + dq.shape[2:])]
-        )
+             dq.reshape((nb * pn,) + dq.shape[2:])])
         in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr,
                                    seed=seed)
         if self.dedup and self.push_mode != "bucketed":

@@ -72,9 +72,28 @@ def process_info() -> Tuple[int, int]:
     return jax.process_index(), jax.process_count()
 
 
-def barrier(name: str = "swiftsnails_barrier") -> None:
-    """All-host sync (MasterTerminate/ClientTerminate equivalent)."""
+# coordination-service barrier ids must be unique per use; all processes run
+# the same program, so a per-name process-local counter agrees fleet-wide
+_barrier_seq: dict = {}
+
+
+def barrier(name: str = "swiftsnails_barrier", timeout_s: float = 120.0) -> None:
+    """All-host sync (MasterTerminate/ClientTerminate equivalent).
+
+    Uses the coordination service's key-value barrier when available: it is
+    pure control-plane (no device collectives), so it works on every backend
+    — the CPU backend has no multiprocess device collectives, which the
+    ``sync_global_devices`` fallback would need.
+    """
     if jax.process_count() <= 1:
+        return
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is not None:
+        seq = _barrier_seq[name] = _barrier_seq.get(name, -1) + 1
+        client.wait_at_barrier(f"{name}:{seq}",
+                               timeout_in_ms=int(timeout_s * 1000))
         return
     from jax.experimental import multihost_utils
 

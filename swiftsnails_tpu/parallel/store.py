@@ -28,6 +28,7 @@ O(batch), exactly like the reference's wire protocol.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import NamedTuple, Optional, Tuple
 
@@ -39,6 +40,25 @@ from jax.sharding import Mesh, NamedSharding
 
 from swiftsnails_tpu.parallel.access import AccessMethod, Slots
 from swiftsnails_tpu.parallel.mesh import table_sharding
+
+
+@contextlib.contextmanager
+def _sharding_invariant_rng():
+    """Pin the partitionable threefry lowering around table init.
+
+    Under the default (non-partitionable) lowering, XLA specializes the
+    random-bit computation to the ``out_shardings`` layout, so the same seed
+    yields a DIFFERENT table on every mesh shape — which breaks mesh-shape
+    invariance (a 1x1 and a 2x4 run could never match) and makes resharded
+    restarts non-reproducible. The partitionable lowering is
+    sharding-invariant by construction; scoping it here keeps every other
+    RNG stream (samplers, dropout, dither) on the process-wide default."""
+    old = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_threefry_partitionable", old)
 
 
 def _scoped(name: str):
@@ -99,14 +119,16 @@ def create_table(
         return TableState(table=param, slots=access.init_slots(shape, dtype))
 
     if mesh is None:
-        return jax.jit(init)()
+        with _sharding_invariant_rng():
+            return jax.jit(init)()
     sharding = table_sharding(mesh)
     # enumerate slot keys without allocating (the table may be 1B rows)
     slot_spec = jax.eval_shape(lambda: access.init_slots(shape, dtype))
     state_shardings = TableState(
         table=sharding, slots={k: sharding for k in slot_spec}
     )
-    return jax.jit(init, out_shardings=state_shardings)()
+    with _sharding_invariant_rng():
+        return jax.jit(init, out_shardings=state_shardings)()
 
 
 def pull(state: TableState, rows: jax.Array, access: Optional[AccessMethod] = None) -> jax.Array:
@@ -320,7 +342,8 @@ def create_packed_small_table(
         return PackedTableState(table=param, slots=slots)
 
     if mesh is None:
-        return jax.jit(init)()
+        with _sharding_invariant_rng():
+            return jax.jit(init)()
     sharding = table_sharding(mesh)
     if fused:
         state_shardings = PackedTableState(table=sharding, slots={})
@@ -329,7 +352,8 @@ def create_packed_small_table(
         state_shardings = PackedTableState(
             table=sharding, slots={k: sharding for k in slot_spec}
         )
-    return jax.jit(init, out_shardings=state_shardings)()
+    with _sharding_invariant_rng():
+        return jax.jit(init, out_shardings=state_shardings)()
 
 
 @_scoped("ssn_pull_packed_small")
@@ -517,14 +541,15 @@ def create_packed_table(
         return PackedTableState(table=param, slots=slots)
 
     if mesh is None:
-        out = jax.jit(init, static_argnums=())()
-        return out
+        with _sharding_invariant_rng():
+            return jax.jit(init, static_argnums=())()
     sharding = table_sharding(mesh)  # rows sharded over "model"; S,128 whole
     slot_spec = jax.eval_shape(lambda: access.init_slots((capacity, s * ROW_LANES), dtype))
     state_shardings = PackedTableState(
         table=sharding, slots={k: sharding for k in slot_spec}
     )
-    return jax.jit(init, out_shardings=state_shardings)()
+    with _sharding_invariant_rng():
+        return jax.jit(init, out_shardings=state_shardings)()
 
 
 def _pad_to_block(rows: jax.Array, invalid_row: int, block: int):

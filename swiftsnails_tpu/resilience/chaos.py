@@ -35,6 +35,16 @@ never recompiled or slowed by the harness):
 ``reload_corrupt`` corrupts the newest on-disk checkpoint right before a
                 live Servant reload — the shadow-verify swap must reject it
                 and keep serving the old version
+``worker_dead`` a cluster worker stops heartbeating forever (silent host
+                death) — its membership lease must expire and its stream
+                range re-lease to survivors (cluster sim; scheduled by
+                cluster-wide applied-batch tick)
+``worker_slow`` a cluster worker's step time inflates while scheduled — the
+                supervisor's EWMA-vs-median straggler policy must shrink its
+                share / duplicate its substeps
+``partition``   a cluster worker computes on but can't reach the supervisor
+                — heartbeats drop, its lease expires, and its stale buffered
+                commits must be refused by first-writer-wins
 ==============  ============================================================
 
 Every injection appends a ``chaos`` ledger event (when a ledger is wired),
@@ -56,6 +66,9 @@ FAULT_KINDS = (
     # The serve_* kinds index by REQUEST number (the serving fault hook),
     # tier_bitflip/reload_corrupt by train step / drill index.
     "serve_io_error", "serve_slow", "tier_bitflip", "reload_corrupt",
+    # cluster-membership kinds (PR 9): consulted by the cluster simulator,
+    # scheduled by cluster-wide applied-batch tick (see cluster/sim.py)
+    "worker_dead", "worker_slow", "partition",
 )
 
 _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<first>\d+)(?:-(?P<last>\d+))?$")
@@ -318,6 +331,16 @@ class ChaosPlan:
                 self._log(kind, index, {"surface": "serve"})
                 return kind
         return None
+
+    # -- cluster-membership faults (consulted by the cluster simulator;
+    # "step" is the cluster-wide applied-batch tick) --------------------------
+
+    def cluster_fault(self, tick: int) -> List[str]:
+        """The cluster faults scheduled at global tick ``tick``, in fire
+        order. The caller picks the victim and ``_log``s the detail (the
+        plan can't know worker identities)."""
+        return [kind for kind in ("worker_dead", "worker_slow", "partition")
+                if self._take(kind, tick)]
 
     def wants_reload_corrupt(self, index: int) -> bool:
         """True when a ``reload_corrupt`` drill is scheduled at ``index`` —

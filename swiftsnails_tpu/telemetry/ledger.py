@@ -449,7 +449,7 @@ def render_report(ledger: Ledger) -> str:
 # chaos drill making it go wrong on purpose), interleaved with run records
 # for context — `ledger-report --failures`
 FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
-                 "retry_exhausted", "breaker", "degraded")
+                 "retry_exhausted", "breaker", "degraded", "membership")
 
 
 def _failure_line(r: Dict) -> str:
@@ -507,6 +507,30 @@ def _failure_line(r: Dict) -> str:
             f"reason={r.get('reason')} rows={r.get('rows')} "
             f"total={r.get('degraded_total')}"
         )
+    if kind == "membership":
+        # the cluster supervisor's lifecycle timeline (cluster/supervisor.py)
+        action = r.get("action", "?")
+        w = r.get("worker")
+        if action == "worker-lost":
+            return (f"  {ts}  WORKER-LOST  {w}  {r.get('reason', '')}"
+                    f"  steps={r.get('steps')}")
+        if action == "reassigned":
+            return (f"  {ts}  REASSIGNED   {w} -> {r.get('to')}  "
+                    f"ranges={r.get('ranges')}")
+        if action == "straggler":
+            return (f"  {ts}  STRAGGLER    {w}  "
+                    f"ewma={r.get('ewma_ms')}ms vs median="
+                    f"{r.get('median_ms')}ms  share->{r.get('share')}")
+        if action == "straggler-clear":
+            return (f"  {ts}  STRAGGLER    {w}  cleared "
+                    f"(ewma={r.get('ewma_ms')}ms)")
+        if action == "backup":
+            return (f"  {ts}  BACKUP       {w} duplicates "
+                    f"{r.get('of')} ranges={r.get('ranges')}")
+        if action == "restore":
+            return (f"  {ts}  MEMBERSHIP   restore frontier="
+                    f"{r.get('frontier')} pool={r.get('pool')}")
+        return f"  {ts}  MEMBERSHIP   {action} {w}"
     return f"  {ts}  {kind}"
 
 
@@ -553,6 +577,16 @@ def render_failures(ledger: Ledger) -> str:
                 f"degraded_share={c.get('degraded_share_pct')}% "
                 f"p99_under_fault={c.get('p99_under_fault_ms')}ms"
             )
+        elif kind == "bench" and isinstance(r.get("payload"), dict) \
+                and isinstance(r["payload"].get("chaos_cluster"), dict):
+            c = r["payload"]["chaos_cluster"]
+            lines.append(
+                f"  {r.get('ts', '?')}  bench    chaos-cluster lane: "
+                f"exact={c.get('accounting_exact')} "
+                f"lost={c.get('lost_count')} dup={c.get('duplicated_count')} "
+                f"reassigned={c.get('reassignments')} "
+                f"loss_parity={c.get('loss_parity')}"
+            )
     if shown == 0:
         lines.append("  (no failure events recorded)")
     return "\n".join(lines)
@@ -597,7 +631,10 @@ def check_regression(
         a_rc, a_msg = _check_chaos_serve_regression(ledger)
         if a_msg:
             msg = f"{msg}\n{a_msg}"
-        return max(2, c_rc, v_rc, t_rc, a_rc), msg
+        k_rc, k_msg = _check_chaos_cluster_regression(ledger)
+        if k_msg:
+            msg = f"{msg}\n{k_msg}"
+        return max(2, c_rc, v_rc, t_rc, a_rc, k_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -619,7 +656,10 @@ def check_regression(
             a_rc, a_msg = _check_chaos_serve_regression(ledger)
             if a_msg:
                 msg = f"{msg}\n{a_msg}"
-            return max(0, c_rc, v_rc, t_rc, a_rc), msg
+            k_rc, k_msg = _check_chaos_cluster_regression(ledger)
+            if k_msg:
+                msg = f"{msg}\n{k_msg}"
+            return max(0, c_rc, v_rc, t_rc, a_rc, k_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -648,7 +688,10 @@ def check_regression(
     a_rc, a_msg = _check_chaos_serve_regression(ledger)
     if a_msg:
         msg = f"{msg}\n{a_msg}"
-    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc), msg
+    k_rc, k_msg = _check_chaos_cluster_regression(ledger)
+    if k_msg:
+        msg = f"{msg}\n{k_msg}"
+    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc, k_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -769,6 +812,59 @@ def _check_chaos_serve_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
         f"chaos-serve ok: availability {avail:.2f}% (floor {floor}%), "
         f"degraded share {c.get('degraded_share_pct')}%, "
         f"p99 under fault {c.get('p99_under_fault_ms')}ms"
+    )
+
+
+def _check_chaos_cluster_regression(
+    ledger: Ledger,
+) -> Tuple[int, Optional[str]]:
+    """Gate the chaos-cluster lane's exactly-once proof alongside the perf
+    headline: the newest bench record carrying a ``chaos_cluster`` block
+    (any platform — batch accounting is correctness, so CPU lane runs
+    count) must show zero lost and zero double-applied batches under the
+    kill/slow/partition storm, a detected + reassigned worker loss, loss
+    parity within the lane's bar, and an unprotected control leg that
+    demonstrably lost its dead worker's range. No chaos-cluster history
+    gates nothing."""
+    with_cc = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("chaos_cluster"), dict)
+    ]
+    if not with_cc:
+        return 0, None
+    c = with_cc[-1]["payload"]["chaos_cluster"]
+    problems = []
+    if c.get("lost_count", 0) or not c.get("accounting_exact", False):
+        problems.append(
+            f"batch accounting is not exact: lost={c.get('lost_count')} "
+            f"({c.get('committed')}/{c.get('total_batches')} committed)")
+    if c.get("duplicated_count", 0):
+        problems.append(
+            f"{c.get('duplicated_count')} batches double-applied "
+            "(first-writer-wins dedup is broken)")
+    if not c.get("workers_lost"):
+        problems.append("no worker loss was detected under the storm")
+    if not c.get("reassignments"):
+        problems.append("the dead worker's range was never reassigned")
+    parity = c.get("loss_parity")
+    bar = c.get("parity_bar", 0.05)
+    if not (isinstance(parity, (int, float)) and parity <= bar):
+        problems.append(
+            f"loss parity {parity} vs the undisturbed control exceeds "
+            f"the {bar} bar")
+    if not c.get("unprotected_hard_failure", True):
+        problems.append(
+            "supervisor-off control leg did NOT lose the dead worker's "
+            "range (the storm is not exercising reassignment)")
+    if problems:
+        return 1, "chaos-cluster REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"chaos-cluster ok: {c.get('committed')}/{c.get('total_batches')} "
+        f"exactly-once (dup_discarded={c.get('dup_discarded')}, "
+        f"stale_rejected={c.get('stale_rejected')}), "
+        f"{c.get('reassignments')} reassignments, "
+        f"loss parity {parity}"
     )
 
 

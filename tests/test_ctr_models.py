@@ -99,20 +99,17 @@ def test_mesh_indivisible_tiles_fall_back(data):
 
 
 @pytest.mark.parametrize("name", ["logreg", "widedeep"])
-@pytest.mark.xfail(
-    reason="known f32 update-order drift: the grouped plane's per-shard "
-    "scatter order differs from the single-device order, and the rounding "
-    "disagreement compounds over the full run well past the rtol=2e-4 bar "
-    "(max abs diff ~0.58). Tracked in docs/ARCHITECTURE.md 'Known tier-1 "
-    "failures'; un-xfail when the intended end-of-run tolerance (or a "
-    "step-bounded comparison) is decided.",
-    strict=False,
-)
 def test_mesh_packed_matches_single_device(name, data):
     """The collective small-row plane must compute the same training result
     as the single-device small-row plane: per-shard merges of the gathered
     batch sum exactly the gradients of the rows each shard owns, so the
-    final tables — and therefore predictions — agree to float tolerance."""
+    final tables — and therefore predictions — agree to float tolerance.
+
+    (Previously xfailed as "f32 update-order drift", max abs diff ~0.58.
+    The real causes were mesh-dependent randomness — non-partitionable
+    threefry specializing random bits to the output sharding — and GSPMD's
+    concatenate mis-assembly summing model-axis replicas; both fixed, see
+    docs/ARCHITECTURE.md.)"""
     labels, feats, _ = data
     mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
     tr_single, s_single = run_model(name, data, num_iters="2")

@@ -312,3 +312,74 @@ def test_ledger_report_failures_cli(tmp_path, capsys):
     rc = ledger_main([path, "--failures"])
     out = capsys.readouterr().out
     assert rc == 0 and "failure timeline" in out and "io_error" in out
+
+
+# ------------------------------------------- resume under reassignment ---
+
+
+def test_resume_under_reassignment_bit_identical(tmp_path):
+    """Losing a worker mid-run, reassigning its span, checkpointing the
+    cursor, and resuming into a FRESH supervisor must replay to a
+    bit-identical final state: the committed-watermark snapshot pins the
+    remaining set, and LeasedStream serves indices smallest-first, so the
+    application order after restore is a pure function of the committed
+    set (the property worker.py's module docstring promises)."""
+    from swiftsnails_tpu.cluster import Supervisor, WorkerClient
+    from swiftsnails_tpu.cluster.sim import make_step_fn
+    from swiftsnails_tpu.cluster.worker import IndexedBatchSource
+
+    N = 12
+    trainer = make_trainer(tmp_path)
+    step_fn = make_step_fn(trainer)
+    root = jax.random.PRNGKey(0)
+
+    def drain(client, state, applied, snapshot_at=None):
+        source = IndexedBatchSource(trainer.batches)
+        snap = snap_state = None
+        while True:
+            try:
+                batch = client._next_batch(source)
+            except StopIteration:
+                break
+            index = client._inflight[-1][1]
+            state, _ = step_fn(state, batch, root, np.uint32(index))
+            applied.append(index)
+            client.on_step(len(applied))
+            if snapshot_at is not None and len(applied) == snapshot_at:
+                snap = client.cursor()
+                # host copy BEFORE the next donated step invalidates it
+                snap_state = jax.tree_util.tree_map(
+                    lambda a: np.array(a), state)
+        return state, snap, snap_state
+
+    # -- leg A: worker loss + reassignment, cursor checkpoint mid-run -------
+    supA = Supervisor(total_batches=N, lease_ms=1e9, grant_batches=4)
+    clientA = WorkerClient(supA, "w0")
+    supA.register("w1")                  # phantom peer leases [0, 4) ...
+    supA.next_range("w1")
+    supA.mark_dead("w1")                 # ... and dies holding it
+    assert supA.workers_lost == 1 and supA.reassignments == 1
+    stateA, snap, snap_state = drain(
+        clientA, trainer.init_state(), appliedA := [], snapshot_at=5)
+    assert supA.accountant.verify(N)["exact"]
+    assert sorted(appliedA) == list(range(N))
+    # the adopted span lands AFTER w0's own first grant: the run really was
+    # perturbed by reassignment, not a disguised in-order control
+    assert appliedA != list(range(N))
+
+    # -- leg B: fresh supervisor restored from the cursor, replay to end ----
+    supB = Supervisor(total_batches=N, lease_ms=1e9, grant_batches=4)
+    supB.restore(snap)
+    clientB = WorkerClient(supB, "w0")
+    stateB, _, _ = drain(
+        clientB, jax.tree_util.tree_map(jnp.asarray, snap_state),
+        appliedB := [])
+    assert supB.accountant.verify(N)["exact"]
+
+    # replay applies exactly the post-snapshot remainder, in the same order
+    assert appliedB == appliedA[5:]
+    la = jax.tree_util.tree_leaves(stateA)
+    lb = jax.tree_util.tree_leaves(stateB)
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        assert np.array_equal(np.asarray(a), np.asarray(b))  # bit-identical

@@ -14,6 +14,7 @@ matches an undisturbed one.
     python tools/chaos_drill.py --fast     # the tier-1 subset
     python tools/chaos_drill.py --json     # machine-readable results
     python tools/chaos_drill.py --serve    # the serving availability matrix
+    python tools/chaos_drill.py --cluster  # the membership drill matrix
 
 ``--serve`` runs the CPU-valid availability drill instead (the bench
 ``chaos-serve`` lane): a seeded fault matrix against a live Servant with
@@ -22,6 +23,14 @@ floor while the unprotected control leg hard-fails, a corrupt checkpoint
 must be rejected by the shadow-verify reload, and the tiered bit-flip
 drill must detect + rebuild with loss parity. Exit is nonzero on a missed
 floor or any failed drill.
+
+``--cluster`` runs the CPU-valid membership drill matrix instead (the bench
+``chaos-cluster`` lane, one fault kind per drill): a simulated virtual-clock
+fleet under worker kill, straggler, and partition faults — plus the composed
+storm — must keep the exactly-once batch-accounting ledger *exact* (zero
+lost, zero double-applied), detect every loss and reassign its range, flag
+the straggler, and hold loss parity with an undisturbed control. Exit is
+nonzero on any lost/duplicated batch or missed recovery.
 
 Every injection and every recovery event lands in the drill's own ledger
 (``<workdir>/<drill>/LEDGER.jsonl``); inspect one with
@@ -76,6 +85,34 @@ def _serve_matrix(args) -> int:
     return 1 if failed else 0
 
 
+def _cluster_matrix(args) -> int:
+    from swiftsnails_tpu.cluster.chaos_lane import run_cluster_drills
+
+    results = run_cluster_drills(workdir=args.workdir, small=True)
+    failed = [k for k, v in results.items() if not v.get("recovered")]
+    if args.json:
+        print(json.dumps({"results": results, "failed": failed}))
+    else:
+        width = max(len(k) for k in results)
+        for name, res in results.items():
+            status = "RECOVERED" if res.get("recovered") else "UNRECOVERED"
+            bad = [c for c, ok in res["checks"].items() if not ok]
+            detail = (
+                f"lost={res['lost']} dup={res['duplicated']} "
+                f"dup_discarded={res['dup_discarded']} "
+                f"stale_rejected={res['stale_rejected']} "
+                f"reassigned={res['reassignments']} "
+                f"stragglers={res['stragglers_flagged']} "
+                f"parity={res['loss_parity']}"
+            ) + (f"  FAILED-CHECKS: {', '.join(bad)}" if bad else "")
+            print(f"{name:<{width}}  {status:<11}  {detail}")
+        print(
+            f"{len(results) - len(failed)}/{len(results)} drills recovered"
+            + (f"; FAILED: {', '.join(failed)}" if failed else "")
+        )
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos_drill",
@@ -91,10 +128,16 @@ def main(argv=None) -> int:
                    help="run the serving availability matrix instead "
                         "(breakers + degraded reads vs the fault schedule; "
                         "nonzero exit on a missed availability floor)")
+    p.add_argument("--cluster", action="store_true",
+                   help="run the cluster membership drill matrix instead "
+                        "(kill/straggle/partition vs the supervisor; nonzero "
+                        "exit on lost/duplicated batches or missed recovery)")
     args = p.parse_args(argv)
 
     if args.serve:
         return _serve_matrix(args)
+    if args.cluster:
+        return _cluster_matrix(args)
 
     from swiftsnails_tpu.resilience.drill import run_drill_matrix
 
