@@ -36,6 +36,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 import json
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -278,9 +279,13 @@ def _deadline():
 def probe_accelerator():
     """Short-deadline jax.devices() in a child process.
 
-    Returns (n_devices, platform) or None if the grant is unavailable. The
-    child is abandoned (not killed) on timeout: killing a client mid-init can
-    wedge the grant server-side for every later process.
+    Returns (n_devices, platform) or None if the grant is unavailable. On
+    timeout the child's whole process group is killed and reaped —
+    ``start_new_session`` makes the child its own group leader, so one
+    ``killpg`` takes out any helper processes PJRT spawned too. (The old
+    abandon-the-child policy leaked a straggler that kept the grant open and
+    starved every later probe.) Every failure mode appends a structured
+    ``outage`` ledger event carrying rc / stderr tail as fields.
     """
     code = (
         "import jax\n"
@@ -298,20 +303,30 @@ def probe_accelerator():
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
-            start_new_session=True,  # survives our exit; never killed
+            start_new_session=True,  # child == its own process-group leader
         )
         out, err = child.communicate(timeout=PROBE_DEADLINE_S)
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(child.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass  # group already gone (or not ours): reap what remains
+        try:
+            out, err = child.communicate(timeout=5)
+        except Exception:
+            out, err = "", ""
         msg = (
-            f"accelerator grant unavailable: probe exceeded {PROBE_DEADLINE_S}s "
-            "(child abandoned, not killed, to avoid wedging the grant)"
+            f"accelerator grant unavailable: probe exceeded "
+            f"{PROBE_DEADLINE_S}s (process group killed)"
         )
         _state["errors"].append(msg)
         # the structured outage record that used to be a hand-written
         # docs/OUTAGE_*.txt line — ledger-report renders the history
         _ledger_event("outage", {
             "probe_duration_s": round(time.monotonic() - t_probe0, 1),
-            "rc": None,  # abandoned, never reaped
+            "rc": child.returncode,
+            "killed": True,
+            "stderr_tail": (err or "").strip().splitlines()[-3:],
             "error": msg,
         })
         return None
@@ -327,12 +342,13 @@ def probe_accelerator():
         if line.startswith("PROBE "):
             _, n, platform = line.split()
             return int(n), platform
-    tail = (err or out).strip().splitlines()[-3:]
-    msg = f"probe exited rc={child.returncode} without a device: {' | '.join(tail)}"
+    msg = f"probe exited rc={child.returncode} without a device"
     _state["errors"].append(msg)
     _ledger_event("outage", {
         "probe_duration_s": round(time.monotonic() - t_probe0, 1),
         "rc": child.returncode,
+        "killed": False,
+        "stderr_tail": (err or out).strip().splitlines()[-3:],
         "error": msg,
     })
     return None

@@ -135,6 +135,14 @@ def _bind(lib, c):
         lib.ssn_ctr_stream_next.restype = c.c_int64
         lib.ssn_ctr_stream_next.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p, c.c_int64]
         lib.ssn_ctr_stream_close.argtypes = [c.c_void_p]
+        lib.ssn_tier_remap.restype = c.c_int64
+        lib.ssn_tier_remap.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_void_p,
+        ]
+        lib.ssn_tier_clock_sweep.restype = c.c_int64
+        lib.ssn_tier_clock_sweep.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_int64, c.c_int64, c.c_void_p,
+        ]
 
 
 def available() -> bool:
@@ -522,3 +530,40 @@ class WindowPrefetcher:
         except Exception:
             pass
 
+
+
+# ---------------------------------------------------------------- tiered ---
+
+
+def tier_remap(slot_of: np.ndarray, rows: np.ndarray,
+               group: int = 1) -> Tuple[np.ndarray, int]:
+    """Master-row ids -> cache-slot ids for the tiered store's per-step remap
+    (``TieredTable.remap`` hot path). Returns ``(slots, n_nonresident)``;
+    the caller raises on a nonzero miss count. Releases the GIL for the
+    duration, so the prefetch producer thread keeps staging."""
+    lib = _require()
+    slot_of = np.ascontiguousarray(slot_of, dtype=np.int64)
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    out = np.empty(rows.size, dtype=np.int32)
+    bad = lib.ssn_tier_remap(
+        _ptr(slot_of), _ptr(rows), rows.size, int(group), _ptr(out))
+    return out, int(bad)
+
+
+def tier_clock_sweep(ref: np.ndarray, pinned: np.ndarray, hand: int,
+                     n: int) -> Tuple[np.ndarray, int]:
+    """CLOCK victim selection (``TieredTable._allocate`` eviction sweep,
+    bit-exact vs the Python loop). Mutates ``ref`` (aging) and ``pinned``
+    (selected slots become pinned) IN PLACE; returns ``(victim_slots,
+    new_hand)``. ``ref`` must be a writable contiguous uint8 array and
+    ``pinned`` a writable contiguous bool/uint8 array of the same length;
+    the caller guarantees ``n`` unpinned slots exist."""
+    lib = _require()
+    assert ref.dtype == np.uint8 and ref.flags.c_contiguous and ref.flags.writeable
+    pin8 = pinned.view(np.uint8)
+    assert pin8.flags.c_contiguous and pin8.flags.writeable
+    assert ref.size == pin8.size
+    out = np.empty(max(int(n), 0), dtype=np.int64)
+    new_hand = lib.ssn_tier_clock_sweep(
+        _ptr(ref), _ptr(pin8), ref.size, int(hand), int(n), _ptr(out))
+    return out, int(new_hand)

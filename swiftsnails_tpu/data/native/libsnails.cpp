@@ -1065,3 +1065,58 @@ extern "C" void ssn_win_prefetch_close(void* h) {
   delete p;
 }
 
+
+// ---------------------------------------------------------------- tiered ---
+// Host-side hot loops of the tiered parameter store (tiered/store.py). Both
+// run per step on the _Prefetcher producer/consumer threads; ctypes releases
+// the GIL for the duration of the call, so the other thread keeps moving.
+
+// Master-row ids -> cache-slot-space ids (TieredTable.remap). slot_of maps
+// unit -> slot (-1 = non-resident); group > 1 packs G logical rows per cache
+// unit (packed-small tiles). Returns the number of non-resident hits; out is
+// fully written either way so the caller can raise with context.
+extern "C" int64_t ssn_tier_remap(const int64_t* slot_of, const int32_t* rows,
+                                  int64_t n, int64_t group, int32_t* out) {
+  int64_t bad = 0;
+  if (group > 1) {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t r = (int64_t)rows[i];
+      int64_t s = slot_of[r / group];
+      if (s < 0) ++bad;
+      out[i] = (int32_t)(s * group + r % group);
+    }
+  } else {
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t s = slot_of[(int64_t)rows[i]];
+      if (s < 0) ++bad;
+      out[i] = (int32_t)s;
+    }
+  }
+  return bad;
+}
+
+// CLOCK hand sweep with pinned-slot masking (TieredTable._allocate eviction
+// loop, bit-exact): skip pinned slots, halve nonzero reference counters as
+// the hand passes (hot rows survive O(log ref) sweeps), take zero-ref slots
+// as victims and pin them so one sweep never picks a slot twice. Mutates
+// ref and pinned in place, writes n victim slots to out, returns the new
+// hand position. The caller guarantees n reachable victims exist (the
+// working-set-vs-budget check in ensure()), matching the Python loop's
+// termination contract.
+extern "C" int64_t ssn_tier_clock_sweep(uint8_t* ref, uint8_t* pinned,
+                                        int64_t budget, int64_t hand,
+                                        int64_t n, int64_t* out) {
+  int64_t k = 0;
+  while (k < n) {
+    int64_t h = hand;
+    hand = (hand + 1) % budget;
+    if (pinned[h]) continue;
+    if (ref[h] > 0) {
+      ref[h] >>= 1;
+      continue;
+    }
+    out[k++] = h;
+    pinned[h] = 1;
+  }
+  return hand;
+}

@@ -301,12 +301,14 @@ def save_checkpoint(
     (the step this run restored from).
 
     ``tier`` (a :class:`~swiftsnails_tpu.tiered.TierManager`) makes the save
-    tier-transparent: every dirty cache slot is flushed host-ward FIRST (the
-    write-back invariant — flush-before-manifest), the full-size
-    master-backed state is what gets written (on-disk format identical to a
-    resident run, so restore/serving need no tier awareness), and the write
-    is forced synchronous — an async write would race with later
-    eviction-flushes mutating the NumPy master planes in place.
+    tier-transparent: the background flush queue is drained and every dirty
+    cache slot flushed host-ward FIRST (the write-back invariant —
+    flush-before-manifest; ``master_state`` is a full barrier even with
+    ``tier_async_flush: 1``), the full-size master-backed state is what gets
+    written (on-disk format identical to a resident run, so restore/serving
+    need no tier awareness), and the write is forced synchronous — an async
+    write would race with later eviction-flushes mutating the NumPy master
+    planes in place.
     """
     if tier is not None:
         state = tier.master_state(state)

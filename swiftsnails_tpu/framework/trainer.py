@@ -104,14 +104,18 @@ class Trainer:
         """Rebuild the state pytree with (some) table states replaced."""
         raise NotImplementedError
 
-    def tier_plan(self, batch: Dict[str, np.ndarray], rng: jax.Array):
+    def tier_plan(self, batch: Dict[str, np.ndarray], root_rng: jax.Array,
+                  step: np.uint32):
         """Host-side plan for one step: ``(ids, aug, remap_keys)`` where
         ``ids[name]`` is every master row id the step will touch in that
         table (hashing already applied), ``aug`` holds batch keys to
         add/replace (e.g. pre-sampled negatives — the in-jit RNG derivation
-        replicated eagerly so the plan is exact, not a guess), and
+        replicated so the plan is exact, not a guess), and
         ``remap_keys[name]`` lists the batch keys to remap into cache-slot
-        space."""
+        space. The per-step key is ``fold_in(root_rng, step)`` — derive it
+        INSIDE a jitted plan fn (the step counter as a uint32 operand, like
+        the step fn itself) so the plan costs one dispatch, not an eager
+        threefry chain."""
         raise NotImplementedError
 
     def tier_warm_rows(self) -> Optional[Dict[str, np.ndarray]]:
@@ -134,6 +138,7 @@ class _Prefetcher:
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
         self._exhausted = False
+        self.last_wait_ns = 0  # consumer block on the last __next__
 
         def produce():
             try:
@@ -175,7 +180,9 @@ class _Prefetcher:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        t0 = time.monotonic_ns()
         item = self._q.get()
+        self.last_wait_ns = time.monotonic_ns() - t0
         if item is self._DONE:
             self._exhausted = True
             self._thread.join()
@@ -188,6 +195,16 @@ class _Prefetcher:
         """Approximate queued-batch count (telemetry gauge: a persistently
         empty queue means the host pipeline is the bottleneck)."""
         return self._q.qsize()
+
+    def set_depth(self, depth: int) -> None:
+        """Grow (or shrink) the queue bound in place — the adaptive
+        ``tier_prefetch_depth: auto`` control. ``queue.Queue`` guards
+        ``maxsize`` with its own mutex; waking ``not_full`` lets a producer
+        blocked on the old bound use the new headroom immediately."""
+        q = self._q
+        with q.mutex:
+            q.maxsize = max(int(depth), 1)
+            q.not_full.notify_all()
 
     def close(self):
         self._stop.set()
@@ -340,7 +357,8 @@ class TrainLoop:
         if table_tier == "host":
             from swiftsnails_tpu.tiered import TierManager
 
-            self.tier = TierManager(trainer, registry=self.registry)
+            self.tier = TierManager(
+                trainer, registry=self.registry, tracer=self.tracer)
         else:
             self.tier = None
         # tier integrity sweep cadence (steps; 0 = only at heal requests).
@@ -379,10 +397,18 @@ class TrainLoop:
             return {k: jnp.asarray(v) for k, v in batch.items()}
         bs = self._batch_sharding
         rep = self._replicated
-        return {
-            k: jax.device_put(v, bs if np.ndim(v) else rep)
-            for k, v in batch.items()
-        }
+        data = bs.mesh.shape.get(DATA_AXIS, 1)
+
+        def put(v):
+            # batch-shard only what actually splits across the data axis;
+            # scalars and step-wide entries (e.g. the tier's pre-sampled
+            # negative pools, whose leading dim counts pools, not examples)
+            # replicate instead
+            if np.ndim(v) and np.shape(v)[0] % data == 0:
+                return jax.device_put(v, bs)
+            return jax.device_put(v, rep)
+
+        return {k: put(v) for k, v in batch.items()}
 
     def run(self, seed: int = 0, max_steps: Optional[int] = None) -> Any:
         trainer = self.trainer
@@ -437,10 +463,15 @@ class TrainLoop:
             src = iter(trainer.batches())
         if tier is not None:
             # stage upcoming steps' plans + missing master rows on the
-            # producer thread so the H2D fault traffic overlaps compute
-            depth = tier.prefetch_depth
+            # producer thread so the H2D fault traffic overlaps compute.
+            # A fully-transparent tier stages nothing — keep the trainer's
+            # own prefetch setting instead of forcing the staging pipeline
             src = tier.stage_stream(src, root_rng)
+            if not tier.all_transparent:
+                depth = tier.prefetch_depth
         batches = _Prefetcher(src, depth=depth) if depth else src
+        if tier is not None and isinstance(batches, _Prefetcher):
+            tier.attach_prefetcher(batches)  # tier_prefetch_depth: auto
         tel = self.tracer
         reg = self.registry
         bb = self.blackbox

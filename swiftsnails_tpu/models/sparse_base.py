@@ -331,10 +331,10 @@ class SparseCTRTrainer(Trainer):
             dense=state.dense, opt=state.opt,
         )
 
-    def tier_plan(self, batch, rng):
+    def tier_plan(self, batch, root_rng, step):
         """Eager twin of the in-jit ``self._rows(feats)`` (same ``hash_row``,
-        deterministic eager-vs-traced). ``rng`` is unused — the CTR step has
-        no sampling."""
+        deterministic eager-vs-traced). The RNG operands are unused — the
+        CTR step has no sampling."""
         feats = jnp.asarray(np.asarray(batch["feats"]))
         rows = np.asarray(hash_row(jnp.maximum(feats, 0), self.capacity))
         return {"table": rows.ravel()}, {"rows": rows}, {"table": ["rows"]}

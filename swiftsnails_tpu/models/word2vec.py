@@ -294,6 +294,7 @@ class Word2VecTrainer(Trainer):
             )
         self.access = SgdAccess()
         self.neg_alias = build_unigram_alias(vocab.counts)
+        self._plan_fns = {}  # (substeps, neg shape) -> jitted tier planner
         if self.resident:
             # surface the kernel's rounding so operators see what actually
             # runs: hot_rows clips to capacity and rounds to the one-hot
@@ -1045,10 +1046,39 @@ class Word2VecTrainer(Trainer):
             out_table=tables.get("out_table", state.out_table),
         )
 
-    def tier_plan(self, batch, rng):
+    def _tier_plan_fn(self, t: int, shape):
+        """One fused, cached jit per (substeps, negative-draw shape): the
+        per-step ``fold_in``, RNG split, alias sampling, and id hashing in a
+        single dispatch (the step counter rides in as a uint32 operand, same
+        as the step fn — no retrace, no eager threefry chain). The plan runs
+        every step on the prefetch producer thread; the previous op-by-op
+        eager chain (~10 dispatches, GIL-held) was the tier's single
+        biggest steady-state cost on the CPU smoke."""
+        fn = self._plan_fns.get((t, shape))
+        if fn is None:
+
+            def plan(root_rng, step, centers, contexts):
+                rng = jax.random.fold_in(root_rng, step)
+                keys = [rng] if t == 1 else list(jax.random.split(rng, t))
+                negs = jnp.concatenate(
+                    [alias_sample(self.neg_alias, key, shape)
+                     for key in keys], axis=0)
+
+                def rows(k):
+                    if self.hash_keys:
+                        return hash_row(k, self.capacity)
+                    return k.astype(jnp.int32)
+
+                return rows(centers), rows(contexts), rows(negs)
+
+            fn = self._plan_fns[(t, shape)] = jax.jit(plan)
+        return fn
+
+    def tier_plan(self, batch, root_rng, step):
         """Host-side step plan: replicate the in-jit RNG derivation
-        (``split`` into per-substep keys, then ``alias_sample``) bit-exactly,
-        hash every id, and report which master rows the step touches.
+        (``fold_in`` then ``split`` into per-substep keys, then
+        ``alias_sample``) bit-exactly, hash every id, and report which
+        master rows the step touches.
 
         Returns ``(ids, aug, remap_keys)``: per-table touched row ids, batch
         augmentations (hashed centers/contexts + the pre-sampled negatives),
@@ -1065,13 +1095,9 @@ class Word2VecTrainer(Trainer):
             shape = (b // pb, self.pool_size)
         else:
             shape = (b, self.negatives)
-        keys = [rng] if t == 1 else list(jax.random.split(rng, t))
-        negs = np.concatenate(
-            [np.asarray(alias_sample(self.neg_alias, key, shape))
-             for key in keys], axis=0)
-        c_r = self._plan_rows(centers)
-        x_r = self._plan_rows(contexts)
-        n_r = self._plan_rows(negs)
+        c_d, x_d, n_d = self._tier_plan_fn(t, shape)(
+            root_rng, np.uint32(step), centers, contexts)
+        c_r, x_r, n_r = np.asarray(c_d), np.asarray(x_d), np.asarray(n_d)
         ids = {
             "in_table": c_r.ravel(),
             "out_table": np.concatenate([x_r.ravel(), n_r.ravel()]),

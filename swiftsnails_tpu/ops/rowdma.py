@@ -29,6 +29,13 @@ an option; equal-sized copies make shared byte-accounting exact):
   which are skipped): uniqueness is what makes the RMW race-free, and is
   guaranteed by the caller via ``merge_duplicate_rows`` (the reference's
   ``merge_push_value`` duplicate merge, ``sparsetable.h:176-179``).
+* :func:`scatter_write_rows` — write-only scatter ``table[r] = value`` for
+  unique rows. This is also the tiered store's slot-install path
+  (``tiered/store.py::_scatter_rowdma``): faulted master rows land in the
+  HBM cache plane from one fused host staging buffer, one DMA per row.
+* :func:`scatter_adagrad_rows` / :func:`scatter_adagrad_fused_rows` —
+  fused AdaGrad RMW (split param/accum buffers, or both packed into one
+  stored tile so a single DMA pair moves them).
 
 Off-TPU these run in interpret mode (same code path, CPU tests). The XLA
 fallback (`jnp.take` / `.at[].add`) remains in ``parallel/store.py``.

@@ -111,24 +111,42 @@ def create_table(
     """
     shape = (capacity, dim)
 
-    def init():
-        rng = jax.random.PRNGKey(seed)
-        param = access.init_param(rng, shape, dtype)
-        if init_scale is not None:
-            param = param * init_scale
-        return TableState(table=param, slots=access.init_slots(shape, dtype))
-
     if mesh is None:
         with _sharding_invariant_rng():
-            return jax.jit(init)()
+            return _init_table(shape, access, dtype, seed, init_scale)
     sharding = table_sharding(mesh)
     # enumerate slot keys without allocating (the table may be 1B rows)
     slot_spec = jax.eval_shape(lambda: access.init_slots(shape, dtype))
-    state_shardings = TableState(
-        table=sharding, slots={k: sharding for k in slot_spec}
-    )
     with _sharding_invariant_rng():
-        return jax.jit(init, out_shardings=state_shardings)()
+        return _sharded_init(
+            shape, access, dtype, seed, init_scale, sharding,
+            tuple(sorted(slot_spec)))()
+
+
+def _init_impl(shape, access, dtype, seed, init_scale):
+    rng = jax.random.PRNGKey(seed)
+    param = access.init_param(rng, shape, dtype)
+    if init_scale is not None:
+        param = param * init_scale
+    return TableState(table=param, slots=access.init_slots(shape, dtype))
+
+
+# jitted ONCE per (shape, access, ...) key: the old ``jax.jit(closure)()``
+# form compiled afresh on every call — a fixed quarter-second XLA tax per
+# ``TrainLoop.run`` that dominated short bench legs
+_init_table = jax.jit(_init_impl, static_argnums=(0, 1, 2, 3, 4))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_init(shape, access, dtype, seed, init_scale, sharding,
+                  slot_keys):
+    """Cached jit wrapper for the sharded-init path (``out_shardings`` is a
+    jit parameter, so each distinct sharding needs its own wrapper)."""
+    state_shardings = TableState(
+        table=sharding, slots={k: sharding for k in slot_keys})
+    return jax.jit(
+        functools.partial(_init_impl, shape, access, dtype, seed, init_scale),
+        out_shardings=state_shardings)
 
 
 def pull(state: TableState, rows: jax.Array, access: Optional[AccessMethod] = None) -> jax.Array:
@@ -526,30 +544,51 @@ def create_packed_table(
     shape = packed_shape(capacity, dim)
     s = shape[1]
 
-    def init():
-        rng = jax.random.PRNGKey(seed)
-        # init as if [capacity, dim]: same distribution, packed placement
-        # (fan_in=dim — scaling by the padded width s*128 would start the
-        # table up to 128/dim too small, see test_path_quality)
-        param = access.init_param(rng, (capacity, s * ROW_LANES), dtype, fan_in=dim)
-        if init_scale is not None:
-            param = param * init_scale
-        lane = jnp.arange(s * ROW_LANES) < dim
-        param = jnp.where(lane[None, :], param, 0).reshape(shape)
-        slots = access.init_slots((capacity, s * ROW_LANES), dtype)
-        slots = {k: v.reshape(shape) for k, v in slots.items()}
-        return PackedTableState(table=param, slots=slots)
-
     if mesh is None:
         with _sharding_invariant_rng():
-            return jax.jit(init, static_argnums=())()
+            return _init_packed_table(shape, dim, access, dtype, seed,
+                                      init_scale)
     sharding = table_sharding(mesh)  # rows sharded over "model"; S,128 whole
-    slot_spec = jax.eval_shape(lambda: access.init_slots((capacity, s * ROW_LANES), dtype))
-    state_shardings = PackedTableState(
-        table=sharding, slots={k: sharding for k in slot_spec}
-    )
+    slot_spec = jax.eval_shape(
+        lambda: access.init_slots((capacity, s * ROW_LANES), dtype))
     with _sharding_invariant_rng():
-        return jax.jit(init, out_shardings=state_shardings)()
+        return _sharded_packed_init(
+            shape, dim, access, dtype, seed, init_scale, sharding,
+            tuple(sorted(slot_spec)))()
+
+
+def _init_packed_impl(shape, dim, access, dtype, seed, init_scale):
+    from swiftsnails_tpu.ops.rowdma import ROW_LANES
+
+    capacity, s, _ = shape
+    rng = jax.random.PRNGKey(seed)
+    # init as if [capacity, dim]: same distribution, packed placement
+    # (fan_in=dim — scaling by the padded width s*128 would start the
+    # table up to 128/dim too small, see test_path_quality)
+    param = access.init_param(rng, (capacity, s * ROW_LANES), dtype, fan_in=dim)
+    if init_scale is not None:
+        param = param * init_scale
+    lane = jnp.arange(s * ROW_LANES) < dim
+    param = jnp.where(lane[None, :], param, 0).reshape(shape)
+    slots = access.init_slots((capacity, s * ROW_LANES), dtype)
+    slots = {k: v.reshape(shape) for k, v in slots.items()}
+    return PackedTableState(table=param, slots=slots)
+
+
+# same once-per-key jit caching as _init_table (see the comment there)
+_init_packed_table = jax.jit(
+    _init_packed_impl, static_argnums=(0, 1, 2, 3, 4, 5))
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_packed_init(shape, dim, access, dtype, seed, init_scale,
+                         sharding, slot_keys):
+    state_shardings = PackedTableState(
+        table=sharding, slots={k: sharding for k in slot_keys})
+    return jax.jit(
+        functools.partial(
+            _init_packed_impl, shape, dim, access, dtype, seed, init_scale),
+        out_shardings=state_shardings)
 
 
 def _pad_to_block(rows: jax.Array, invalid_row: int, block: int):

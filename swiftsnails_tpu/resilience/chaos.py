@@ -312,6 +312,12 @@ class ChaosPlan:
             self._log("tier_bitflip", step, {"detail": "skipped: no tier"})
             return None
         name = names[int(self.rng.integers(0, len(names)))]
+        # barrier the async flush queue: a landing that read the row before
+        # the flip would scatter over it and erase the injected corruption
+        # before the integrity sweep ever sees it
+        drain = getattr(tier, "_drain", None)
+        if drain is not None:
+            drain()
         flat = tier.tables[name].master.table.view(np.uint8).reshape(-1)
         off = int(self.rng.integers(0, flat.size))
         bit = int(self.rng.integers(0, 8))

@@ -86,6 +86,8 @@ _SPAN_BUCKETS = {
     "step": "compute_s",          # jitted dispatch + device sync
     "h2d": "h2d_s",
     "prefetch-wait": "host_blocked_s",
+    "tier-fault": "host_blocked_s",       # tiered residency work on the step
+    "tier-flush-wait": "host_blocked_s",  # async write-back drain barriers
     "metrics-flush": "other_s",
     "checkpoint": "other_s",
 }

@@ -421,6 +421,21 @@ def render_report(ledger: Ledger) -> str:
                     f"parity={t.get('parity_bit_identical')}  "
                     f"over_budget_round_trip={t.get('round_trip_ok')}"
                 )
+            bd = t.get("breakdown")
+            if isinstance(bd, dict) and any(
+                    bd.get(k) for k in ("plan_ns", "fault_ns", "flush_ns",
+                                        "remap_ns", "h2d_ns")):
+                lines.append(
+                    "    step-time: "
+                    + "  ".join(
+                        f"{k[:-3]}={bd[k] / 1e6:.1f}ms"
+                        for k in ("plan_ns", "fault_ns", "flush_ns",
+                                  "remap_ns", "h2d_ns", "flush_wait_ns")
+                        if isinstance(bd.get(k), (int, float)) and bd[k]
+                    )
+                    + (f"  flush_q={bd.get('flush_queue_depth', 0)}"
+                       if "flush_queue_depth" in bd else "")
+                )
 
     outages = ledger.records("outage")
     if outages:
@@ -949,14 +964,19 @@ def _tiered_values(record: Dict) -> Optional[Tuple[float, bool]]:
     return float(wps), parity
 
 
+_TIERED_RESIDENT_FLOOR = 0.95  # equal-vocab leg: tiered words/sec vs resident
+
+
 def _check_tiered_regression(
     ledger: Ledger, max_drop_pct: float
 ) -> Tuple[int, Optional[str]]:
     """Gate the tiered lane: the newest bench record carrying a ``tiered``
     block must hold bit-parity + the over-budget round trip (correctness —
-    gated on ANY platform, like chaos recovery) and its words/sec floor
-    against the best earlier record of the same platform. No tiered history
-    gates nothing."""
+    gated on ANY platform, like chaos recovery), keep the equal-vocab leg at
+    >= ``_TIERED_RESIDENT_FLOOR`` of resident speed (any platform; older
+    records without the ratio are not gated on it), and hold its words/sec
+    floor against the best earlier record of the same platform. No tiered
+    history gates nothing."""
     with_tiered = [
         r for r in ledger.records("bench")
         if isinstance(r.get("payload"), dict) and _tiered_values(r)
@@ -969,6 +989,12 @@ def _check_tiered_regression(
         return 1, (
             "tiered REGRESSION: newest lane record failed bit-parity or the "
             "over-budget round trip (correctness gate)")
+    ratio = newest_rec["payload"]["tiered"].get("tiered_over_resident")
+    if isinstance(ratio, (int, float)) and ratio < _TIERED_RESIDENT_FLOOR:
+        return 1, (
+            f"tiered REGRESSION: equal-vocab leg ran at {ratio:.4f}x "
+            f"resident speed (floor {_TIERED_RESIDENT_FLOOR:.2f}x) — the "
+            "tier's hot path is paying per-step cost it shouldn't")
     platform = newest_rec["payload"].get("platform")
     same = [r for r in with_tiered
             if r["payload"].get("platform") == platform]

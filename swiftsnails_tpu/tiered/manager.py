@@ -25,18 +25,28 @@ ahead of time and the tiered run stays bit-identical to the resident one.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
 import jax
 
-from swiftsnails_tpu.tiered.store import HostMaster, TieredTable, TierStats
+from swiftsnails_tpu.tiered.store import (
+    HostMaster, TieredTable, TierStats, _FlushQueue,
+)
 from swiftsnails_tpu.utils.config import ConfigError
+
+# tier_prefetch_depth: auto — start shallow, deepen while the consumer
+# measurably stalls on the staging queue
+_AUTO_DEPTH_START = 2
+_AUTO_DEPTH_MAX = 8
+_AUTO_WINDOW = 16  # prepare() calls per adaptation decision
+_AUTO_STALL_NS = 1_000_000  # a >1ms prefetch wait counts as a stall
 
 
 class TierManager:
-    def __init__(self, trainer, registry=None):
+    def __init__(self, trainer, registry=None, tracer=None):
         spec = trainer.tier_spec()
         if spec is None:
             raise ConfigError(
@@ -48,22 +58,44 @@ class TierManager:
         self.budget_mb = cfg.get_float("tier_hbm_budget_mb", 64.0)
         if self.budget_mb <= 0:
             raise ConfigError("tier_hbm_budget_mb must be > 0")
-        self.prefetch_depth = cfg.get_int("tier_prefetch_depth", 2)
+        raw_depth = cfg.get_str("tier_prefetch_depth", "2")
+        self.prefetch_auto = raw_depth.strip().lower() == "auto"
+        self.prefetch_depth = (
+            _AUTO_DEPTH_START if self.prefetch_auto
+            else cfg.get_int("tier_prefetch_depth", 2))
         self.checksums = cfg.get_bool("tier_checksums", True)
+        self.async_flush = cfg.get_bool("tier_async_flush", True)
+        self.flush_batch = cfg.get_int("tier_flush_batch", 8)
+        if self.flush_batch <= 0:
+            raise ConfigError("tier_flush_batch must be > 0")
         from swiftsnails_tpu.resilience.retry import RetryPolicy
 
         # shared policy over the tier's fallible host I/O (master flush at
         # checkpoint/end-of-run, heal-time checkpoint restore)
         self.retry = RetryPolicy.from_config(cfg)
         self.registry = registry
+        self.tracer = tracer
         self.stats = TierStats()
         self.tables: Dict[str, TieredTable] = {}
         self._published: Dict[str, int] = {}
+        # one queue shared by every table: a single worker keeps D2H traffic
+        # serialized (and coalesced across tables in one batch)
+        self.flusher = (
+            _FlushQueue(batch=self.flush_batch, registry=registry)
+            if self.async_flush else None)
+        self._prefetcher = None  # set via attach_prefetcher when depth=auto
+        self._wait_win: list = []
+        # every table in pass-through mode (budget covers the whole master,
+        # identity slot map): prepare()/stage_stream() skip all per-step
+        # tier work and the run moves at resident speed. Set in adopt().
+        self.all_transparent = False
 
     # -- lifecycle ----------------------------------------------------------
 
     def adopt(self, state):
         """Device planes -> host masters + device cache planes (+ prewarm)."""
+        self._drain()  # re-adopt (bench lane re-run): no stragglers from the
+        # previous generation of tables may land after the masters rebuild
         tabs = self.trainer.tier_tables(state)
         budget_each = self.budget_mb / max(len(tabs), 1)
         caches = {}
@@ -75,30 +107,53 @@ class TierManager:
             units = int(budget_each * (1 << 20) // max(master.unit_nbytes, 1))
             tt = TieredTable(
                 master, units, mesh=self.trainer.mesh, name=name,
-                stats=self.stats,
+                stats=self.stats, flusher=self.flusher,
             )
             self.tables[name] = tt
-            caches[name] = tt.make_cache()
-        warm = self.trainer.tier_warm_rows()
-        if warm:
-            for name, rows in warm.items():
-                tt = self.tables.get(name)
-                if tt is None or rows is None or not len(rows):
-                    continue
-                caches[name] = tt.prewarm(
-                    caches[name], tt.units_for(np.asarray(rows)))
+            if tt.budget >= tt.master.units:
+                # the budget covers the whole table: the trainer's device
+                # plane IS the cache — identity slot map, zero copies, and
+                # the table enters transparent (pass-through) mode
+                caches[name] = tt.adopt_resident(st)
+            else:
+                caches[name] = tt.make_cache()
+        warm = self.trainer.tier_warm_rows() or {}
+        for name, tt in self.tables.items():
+            if tt.transparent:
+                continue
+            rows = warm.get(name)
+            if rows is None or not len(rows):
+                continue
+            caches[name] = tt.prewarm(
+                caches[name], tt.units_for(np.asarray(rows)))
+        self.all_transparent = bool(self.tables) and all(
+            tt.transparent for tt in self.tables.values())
         self._publish()
         return self.trainer.tier_with_tables(state, caches)
 
     # -- per-step fault + remap ----------------------------------------------
 
     def _plan(self, batch, root_rng, step: int):
-        rng = jax.random.fold_in(root_rng, np.uint32(step))
-        return self.trainer.tier_plan(batch, rng)
+        t0 = time.monotonic_ns()
+        # the per-step fold_in happens INSIDE the trainer's jitted plan (the
+        # same trick the step fn uses): an eager fold_in here costs ~0.3ms
+        # of host dispatch per step, dominating the tier's steady-state cost
+        out = self.trainer.tier_plan(batch, root_rng, np.uint32(step))
+        self.stats.plan_ns += time.monotonic_ns() - t0
+        return out
 
     def prepare(self, state, batch, root_rng, step: int):
         """Fault + remap for one step; returns ``(state, batch)`` with the
         cache planes updated and every table id in cache-slot space."""
+        if self.all_transparent:
+            # pass-through: identity slot map + full coverage means the raw
+            # batch already addresses the cache correctly and the step
+            # samples its own negatives in-jit, exactly like a resident run
+            self.stats.transparent_steps += 1
+            if "_tier_staged" in batch:
+                batch = {k: v for k, v in batch.items()
+                         if k != "_tier_staged"}
+            return state, batch
         staged = batch.pop("_tier_staged", None) if "_tier_staged" in batch else None
         if staged is not None and staged.get("step") != step:
             staged = None  # stale hint (e.g. resume: 1 offsets the stream)
@@ -110,6 +165,8 @@ class TierManager:
         out_batch = {k: v for k, v in batch.items() if k != "_tier_staged"}
         out_batch.update(aug)
         new_tabs = {}
+        faults0 = self.stats.faults
+        t_fault0 = time.monotonic_ns()
         for name, tt in self.tables.items():
             payload = staged["payload"].get(name) if staged else None
             st = tt.ensure(
@@ -117,8 +174,39 @@ class TierManager:
             new_tabs[name] = st
             for key in remap_keys.get(name, ()):
                 out_batch[key] = tt.remap(out_batch[key])
+        if self.registry is not None and self.stats.faults > faults0:
+            self.registry.histogram("tier_fault_ms").observe(
+                (time.monotonic_ns() - t_fault0) / 1e6)
+        self._adapt_prefetch()
         self._publish()
         return self.trainer.tier_with_tables(state, new_tabs), out_batch
+
+    # -- adaptive prefetch depth ---------------------------------------------
+
+    def attach_prefetcher(self, pf) -> None:
+        """``tier_prefetch_depth: auto``: hand the manager the live
+        ``_Prefetcher`` so it can watch per-step queue waits and deepen the
+        staging pipeline while the consumer measurably stalls. No-op for a
+        fixed depth."""
+        self._prefetcher = pf if self.prefetch_auto else None
+        self._wait_win = []
+
+    def _adapt_prefetch(self) -> None:
+        pf = self._prefetcher
+        if pf is None:
+            return
+        self._wait_win.append(getattr(pf, "last_wait_ns", 0))
+        if len(self._wait_win) < _AUTO_WINDOW:
+            return
+        waits = self._wait_win
+        self._wait_win = []
+        stalled = sum(1 for w in waits if w > _AUTO_STALL_NS)
+        if stalled * 2 >= len(waits) and self.prefetch_depth < _AUTO_DEPTH_MAX:
+            self.prefetch_depth = min(self.prefetch_depth * 2, _AUTO_DEPTH_MAX)
+            pf.set_depth(self.prefetch_depth)
+            if self.registry is not None:
+                self.registry.gauge("tier_prefetch_depth").set(
+                    self.prefetch_depth)
 
     # -- prefetch staging -----------------------------------------------------
 
@@ -130,6 +218,8 @@ class TierManager:
         consumer mutates the slot map concurrently) — that only costs
         efficiency, never correctness: :meth:`prepare` re-checks residency
         and host-gathers anything the stage missed."""
+        if self.all_transparent:
+            return src  # pass-through: nothing to plan or stage
 
         def gen():
             for i, b in enumerate(src):
@@ -154,8 +244,10 @@ class TierManager:
             t_rows, s_rows = tt.master.gather(missing)
             self.stats.h2d_bytes += t_rows.nbytes + sum(
                 v.nbytes for v in s_rows.values())
+            t0 = time.monotonic_ns()
             dev_t = self._to_device(t_rows)
             dev_s = {k: self._to_device(v) for k, v in s_rows.items()}
+            self.stats.h2d_ns += time.monotonic_ns() - t0
             payload[name] = (missing, vers, dev_t, dev_s)
         return {"step": step, "plan": plan, "payload": payload}
 
@@ -171,10 +263,25 @@ class TierManager:
 
     # -- write-back / reporting -----------------------------------------------
 
+    def _drain(self) -> None:
+        """Barrier on the async flush queue, attributed to the trace (the
+        ``tier-flush-wait`` span folds into the goodput ``host_blocked``
+        decomposition)."""
+        if self.flusher is None:
+            return
+        if self.tracer is not None:
+            with self.tracer.span("tier-flush-wait"):
+                self.flusher.drain()
+        else:
+            self.flusher.drain()
+
     def master_state(self, state):
         """Flush every dirty slot, then return the full-size master-backed
         state (same pytree type/shapes/dtypes; NumPy leaves). The flush
-        happens *before* the caller builds any checkpoint manifest."""
+        happens *before* the caller builds any checkpoint manifest — with
+        async write-back on, ``flush`` first drains the background queue, so
+        this is a full barrier either way."""
+        self._drain()
         tabs = self.trainer.tier_tables(state)
         for name, tt in self.tables.items():
             self.retry.call(tt.flush, tabs[name], op=f"tier_flush:{name}")
@@ -185,7 +292,10 @@ class TierManager:
 
     def verify(self) -> Dict[str, list]:
         """Recompute every master plane digest; returns ``{table: [corrupt
-        plane, ...]}`` for the tables that fail (empty dict = all intact)."""
+        plane, ...]}`` for the tables that fail (empty dict = all intact).
+        Drains the async flush queue first — a digest recomputed mid-scatter
+        would be a false corruption alarm."""
+        self._drain()
         bad = {}
         for name, tt in self.tables.items():
             planes = tt.master.verify()
@@ -210,6 +320,7 @@ class TierManager:
             CheckpointError, candidate_steps, restore_checkpoint,
         )
 
+        self._drain()  # no flush may land while masters are being replaced
         corrupt = self.verify() if corrupt is None else corrupt
         if not corrupt:
             return None, []
@@ -245,6 +356,12 @@ class TierManager:
 
     def summary(self) -> Dict:
         out = self.stats.as_dict()
+        out["async_flush"] = bool(self.flusher is not None)
+        out["flush_queue_depth"] = (
+            self.flusher.qsize() if self.flusher is not None else 0)
+        out["prefetch_depth"] = self.prefetch_depth
+        out["prefetch_auto"] = self.prefetch_auto
+        out["transparent"] = self.all_transparent
         out["tables"] = {
             name: {
                 "budget_slots": tt.budget,
@@ -264,6 +381,8 @@ class TierManager:
         if reg is None:
             return
         reg.gauge("tier_cache_hit_rate").set(self.stats.hit_rate)
+        if self.flusher is not None:
+            reg.gauge("tier_flush_queue_depth").set(self.flusher.qsize())
         for key in ("h2d_bytes", "d2h_bytes", "faults", "faulted_rows",
                     "evictions", "flushed_rows"):
             cur = getattr(self.stats, key)

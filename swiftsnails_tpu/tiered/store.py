@@ -32,13 +32,34 @@ are pinned for the duration of the fault.
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+_native_mod = None  # resolved once: the module when usable, False when not
+
+
+def _native():
+    """The native libsnails bindings when the toolchain built them, else
+    ``None`` (callers take the NumPy/Python path). Resolved once per process
+    — ``available()`` triggers the on-demand g++ build on first use, exactly
+    like the data-pipeline call sites."""
+    global _native_mod
+    if _native_mod is None:
+        try:
+            from swiftsnails_tpu.data import native
+
+            _native_mod = native if native.available() else False
+        except Exception:
+            _native_mod = False
+    return _native_mod or None
 
 
 @dataclass
@@ -46,7 +67,15 @@ class TierStats:
     """Shared counters for the telemetry surface (goodput block, ledger run
     record, bench ``tiered`` lane). ``lookups``/``hits`` count unique units
     per fault batch; ``faulted_rows``/``evictions`` count cache units (rows
-    for the dense/packed layouts, tiles for packed-small)."""
+    for the dense/packed layouts, tiles for packed-small).
+
+    The ``*_ns`` fields are the step-time breakdown: host nanoseconds spent
+    planning (eager RNG replication, mostly on the prefetch producer thread),
+    faulting (``ensure``: residency check + allocation + install dispatch,
+    including any flush-queue wait), flushing (synchronous write-back +
+    async landings on the flush worker), remapping ids to slot space, and
+    dispatching H2D copies of row payloads. Updated from multiple threads
+    without locks — a rare lost sample costs telemetry accuracy only."""
 
     lookups: int = 0
     hits: int = 0
@@ -58,10 +87,30 @@ class TierStats:
     h2d_bytes: int = 0
     d2h_bytes: int = 0
     prewarmed_rows: int = 0
+    plan_ns: int = 0
+    fault_ns: int = 0
+    flush_ns: int = 0
+    remap_ns: int = 0
+    h2d_ns: int = 0
+    flush_wait_ns: int = 0  # consumer blocked on the flush queue (drain/full)
+    transparent_steps: int = 0  # steps served by the pass-through fast path
 
     @property
     def hit_rate(self) -> float:
         return self.hits / self.lookups if self.lookups else 0.0
+
+    def breakdown(self) -> Dict:
+        """The tiered step-time breakdown block (bench JSON + ledger)."""
+        return {
+            "plan_ns": self.plan_ns,
+            "fault_ns": self.fault_ns,
+            "flush_ns": self.flush_ns,
+            "remap_ns": self.remap_ns,
+            "h2d_ns": self.h2d_ns,
+            "flush_wait_ns": self.flush_wait_ns,
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+        }
 
     def as_dict(self) -> Dict:
         return {
@@ -76,6 +125,8 @@ class TierStats:
             "h2d_bytes": self.h2d_bytes,
             "d2h_bytes": self.d2h_bytes,
             "prewarmed_rows": self.prewarmed_rows,
+            "transparent_steps": self.transparent_steps,
+            "breakdown": self.breakdown(),
         }
 
 
@@ -243,6 +294,118 @@ class HostMaster:
         return self.kind(table=self.table, slots=dict(self.slots))
 
 
+class _FlushQueue:
+    """Bounded background write-back drain (``tier_async_flush``).
+
+    The eviction path hands each dirty-victim batch over as already-dispatched
+    device gathers (the device snapshot is taken before the slot is reused);
+    the worker thread blocks on the D2H ``device_get`` off the step path,
+    coalesces up to ``batch`` queued entries per table, and lands them in the
+    host masters with one ``scatter`` per table. Correctness rides the
+    existing generation protocol: ``master_ver`` bumps only at landing (after
+    the master scatter), so a staged install racing an in-flight flush either
+    sees the bumped version (flush landed -> mismatch -> discard) or finds the
+    unit still pending (the consumer drains before gathering it — see
+    ``TieredTable.ensure``). At most one in-flight entry ever holds a given
+    unit, because refaulting a pending unit forces that drain first — which is
+    what lets the worker concatenate entries and scatter them in one call.
+
+    ``drain()`` is the barrier ``master_state``, checkpoint save, ``heal``,
+    ``verify``, and end-of-run use: it returns only when every queued entry
+    has landed. Worker errors re-raise at the next ``drain()`` or ``put()``.
+    The worker thread starts lazily on the first ``put`` — a run that never
+    evicts (or a serving tier, which is read-only) never spawns it.
+    """
+
+    def __init__(self, depth: int = 8, batch: int = 8, registry=None):
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+        self._batch = max(int(batch), 1)
+        self._registry = registry
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._gate = threading.Event()  # test hook: cleared => worker pauses
+        self._gate.set()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def put(self, table: "TieredTable", units: np.ndarray, n: int,
+            t_dev, s_dev: Dict) -> None:
+        """Enqueue one eviction's dirty victims; blocks when the queue is
+        full (bounded backpressure — the step path waits rather than letting
+        unlanded device snapshots grow without bound)."""
+        self._raise_pending()
+        if self._thread is None:
+            with self._lock:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._work, daemon=True,
+                        name="tier-flush-worker")
+                    self._thread.start()
+        self._q.put((table, units, n, t_dev, s_dev))
+
+    def drain(self) -> None:
+        """Block until every queued entry has landed in its master; re-raise
+        any worker error. This is the flush-before-manifest barrier."""
+        self._q.join()
+        self._raise_pending()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # test hooks: freeze/unfreeze the worker to force gather/flush
+    # interleavings deterministically
+    def pause(self) -> None:
+        self._gate.clear()
+
+    def resume(self) -> None:
+        self._gate.set()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._gate.set()
+
+    def _work(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            entries = [first]
+            while len(entries) < self._batch:
+                try:
+                    entries.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            self._gate.wait()
+            try:
+                self._land(entries)
+            except BaseException as e:  # surfaced at the next drain/put
+                self._err = e
+            finally:
+                for _ in entries:
+                    self._q.task_done()
+            if self._registry is not None:
+                self._registry.gauge("tier_flush_queue_depth").set(
+                    self._q.qsize())
+
+    def _land(self, entries: List[Tuple]) -> None:
+        t0 = time.monotonic_ns()
+        by_table: Dict[int, Tuple["TieredTable", List[Tuple]]] = {}
+        for table, units, n, t_dev, s_dev in entries:
+            by_table.setdefault(id(table), (table, []))[1].append(
+                (units, n, t_dev, s_dev))
+        for table, chunks in by_table.values():
+            table._land_flush(chunks)
+        if self._registry is not None:
+            self._registry.histogram("tier_flush_ms").observe(
+                (time.monotonic_ns() - t0) / 1e6)
+
+
 class TieredTable:
     """Fixed-budget HBM cache + slot map over one :class:`HostMaster`.
 
@@ -260,9 +423,23 @@ class TieredTable:
         name: str = "",
         stats: Optional[TierStats] = None,
         read_only: bool = False,
+        flusher: Optional[_FlushQueue] = None,
     ):
         self.master = master
         self.mesh = mesh
+        # async write-back: eviction flushes enqueue here instead of blocking
+        # the step on the D2H + master scatter; None = synchronous (serving,
+        # direct constructions, tier_async_flush: 0)
+        self.flusher = flusher
+        # units with an enqueued-but-unlanded flush (at most one in-flight
+        # entry per unit — refaulting a pending unit drains first). Allocated
+        # lazily: a run that never evicts pays nothing.
+        self._pending: Optional[np.ndarray] = None
+        # rowdma install path state: tri-state eligibility cache plus the
+        # reusable pinned host staging buffers, keyed by padded batch size
+        self._rowdma: Optional[bool] = None
+        self.rowdma_interpret = False  # test hook: run the kernel off-TPU
+        self._staging: Dict[int, np.ndarray] = {}
         self.name = name or "table"
         self.stats = stats if stats is not None else TierStats()
         self.read_only = read_only
@@ -281,6 +458,13 @@ class TieredTable:
         self.dirty = np.zeros(self.budget, bool)
         self.hand = 0
         self.used = 0  # slots handed out before the clock ever has to evict
+        # transparent (pass-through) mode: the budget covers EVERY master
+        # unit and the prewarm installed the identity slot map, so no step
+        # can ever fault, evict, or need a remap — the per-step plan/ensure
+        # bookkeeping is skipped entirely and the tiered run moves at
+        # resident speed. Write-back correctness shifts from per-step dirty
+        # marking to flush-time "every used slot is dirty" (see flush()).
+        self.transparent = False
         # per-unit write-back generation: bumped after every master write, so
         # a staged (prefetched) row whose unit was fault->update->evict-flushed
         # between stage and install is detected as stale and re-gathered —
@@ -315,8 +499,21 @@ class TieredTable:
 
     def remap(self, rows: np.ndarray) -> np.ndarray:
         """Master row ids -> cache-slot-space row ids (shape/dtype
-        preserved). Every unit must be resident (call :meth:`ensure` first)."""
+        preserved). Every unit must be resident (call :meth:`ensure` first).
+
+        Takes the native (GIL-releasing) path for int32 ids when libsnails
+        built; the NumPy expression below is the exact reference semantics."""
         rows = np.asarray(rows)
+        t0 = time.monotonic_ns()
+        nat = _native()
+        if nat is not None and rows.dtype == np.int32:
+            out, bad = nat.tier_remap(self.slot_of, rows.ravel(), self.group)
+            if bad:
+                raise RuntimeError(
+                    f"tiered[{self.name}]: remap hit a non-resident unit — "
+                    "ensure() must cover every id the step touches")
+            self.stats.remap_ns += time.monotonic_ns() - t0
+            return out.reshape(rows.shape)
         if self.group > 1:
             units = rows // self.group
             slots = self.slot_of[units]
@@ -327,6 +524,7 @@ class TieredTable:
             raise RuntimeError(
                 f"tiered[{self.name}]: remap hit a non-resident unit — "
                 "ensure() must cover every id the step touches")
+        self.stats.remap_ns += time.monotonic_ns() - t0
         return out.astype(rows.dtype)
 
     def peek_missing(self, units: np.ndarray) -> np.ndarray:
@@ -349,6 +547,7 @@ class TieredTable:
         every touched slot dirty — the push *will* write it; serving never
         does).
         """
+        t_ensure0 = time.monotonic_ns()
         if mark_dirty is None:
             mark_dirty = not self.read_only
         uniq = np.unique(np.asarray(units).ravel())
@@ -372,6 +571,14 @@ class TieredTable:
                     f"{int(hit_slots.size) + int(miss.size)} distinct cache "
                     f"units but the HBM budget holds only {self.budget}; "
                     "raise tier_hbm_budget_mb (or shrink the batch)")
+            if self._pending is not None and self._pending[miss].any():
+                # refault of a unit whose eviction flush is still in flight:
+                # the master copy is stale until that entry lands, and the
+                # staged version check alone cannot catch a gather taken at
+                # the still-current generation — wait the queue out first
+                t0 = time.monotonic_ns()
+                self.flusher.drain()
+                self.stats.flush_wait_ns += time.monotonic_ns() - t0
             new_slots = self._allocate(hit_slots, cache, int(miss.size))
             self.unit_of[new_slots] = miss
             self.slot_of[miss] = new_slots
@@ -382,11 +589,14 @@ class TieredTable:
             cache = self._install(cache, miss, new_slots, staged)
         if mark_dirty and uniq.size:
             self.dirty[self.slot_of[uniq]] = True
+        self.stats.fault_ns += time.monotonic_ns() - t_ensure0
         return cache
 
     def _allocate(self, pinned_slots: np.ndarray, cache, n: int) -> np.ndarray:
         """Grab ``n`` cache slots: unassigned first, then CLOCK eviction
-        (dirty victims are flushed to the master before reuse)."""
+        (dirty victims are flushed to the master before reuse). The sweep
+        runs in libsnails when built (it releases the GIL, so the prefetch
+        producer keeps moving); the Python loop below is bit-exact."""
         out = np.empty(n, np.int64)
         k = 0
         while k < n and self.used < self.budget:
@@ -397,6 +607,12 @@ class TieredTable:
             pinned = np.zeros(self.budget, bool)
             pinned[pinned_slots] = True
             pinned[out[:k]] = True
+            nat = _native()
+            if nat is not None:
+                victims, self.hand = nat.tier_clock_sweep(
+                    self.ref, pinned, self.hand, n - k)
+                out[k:] = victims
+                k = n
             while k < n:
                 h = self.hand
                 self.hand = (self.hand + 1) % self.budget
@@ -450,11 +666,87 @@ class TieredTable:
             cache = self._scatter_state(cache, host_slots, t_rows, s_rows)
         return cache
 
+    def _rowdma_ok(self) -> bool:
+        """Whether faulted host rows install via the Pallas row-scatter
+        kernel. Cached after first use — tests setting ``rowdma_interpret``
+        must do so before the first fault (or reset ``_rowdma`` to None)."""
+        if self._rowdma is None:
+            from swiftsnails_tpu.ops import rowdma
+
+            planes = [self.master.table] + [
+                self.master.slots[k] for k in sorted(self.master.slots)]
+            self._rowdma = (
+                self.mesh is None
+                and (rowdma.on_tpu() or self.rowdma_interpret)
+                and all(
+                    p.ndim == 3
+                    and p.shape[-1] == rowdma.ROW_LANES
+                    and p.dtype == self.master.table.dtype
+                    for p in planes)
+            )
+        return self._rowdma
+
+    def _scatter_rowdma(self, cache, idx: np.ndarray, table_rows, slot_rows,
+                        n: int, b: int):
+        """Install host rows through the double-buffered rowdma scatter from
+        ONE fused H2D copy: every plane's rows land in a reusable host
+        staging buffer (concatenated along the sublane axis), a single
+        ``jnp.asarray`` moves the batch, and each plane is sliced out on
+        device. The pow2 pad index == ``budget`` rides the kernel's
+        rows >= capacity skip, exactly like the OOB-drop scatter."""
+        from swiftsnails_tpu.ops.rowdma import scatter_write_rows
+
+        t0 = time.monotonic_ns()
+        keys = sorted(slot_rows)
+        spans = [("table", int(self.master.table.shape[1]))] + [
+            (k, int(self.master.slots[k].shape[1])) for k in keys]
+        total = sum(s for _, s in spans)
+        lanes = int(self.master.table.shape[2])
+        buf = self._staging.get(b)
+        if buf is None or buf.shape != (b, total, lanes):
+            buf = self._staging[b] = np.zeros(
+                (b, total, lanes), self.master.table.dtype)
+        off = 0
+        for name, s in spans:
+            rows = table_rows if name == "table" else slot_rows[name]
+            buf[:n, off:off + s] = rows
+            off += s
+        idx_p = np.full(b, self.budget, np.int32)
+        idx_p[:n] = np.asarray(idx)
+        fused = jnp.asarray(buf)  # the one H2D for the whole fault batch
+        rows_dev = jnp.asarray(idx_p)
+        blk = min(b, 512)  # both pow2, so b % blk == 0
+        table = cache.table
+        slots = dict(cache.slots)
+        off = 0
+        for name, s in spans:
+            vals = fused[:, off:off + s, :]
+            off += s
+            if name == "table":
+                table = scatter_write_rows(
+                    table, rows_dev, vals, block_rows=blk,
+                    interpret=self.rowdma_interpret)
+            else:
+                slots[name] = scatter_write_rows(
+                    slots[name], rows_dev, vals, block_rows=blk,
+                    interpret=self.rowdma_interpret)
+        self.stats.h2d_ns += time.monotonic_ns() - t0
+        return self.master.kind(table=table, slots=slots)
+
     def _scatter_state(self, cache, idx: np.ndarray, table_rows, slot_rows):
         """One bucketed scatter per leaf; pow2 padding (pad index == budget,
         dropped by the OOB-drop scatter) bounds retraces logarithmically."""
         n = int(np.asarray(idx).size)
         b = _pow2(max(n, 1))
+        if (
+            isinstance(table_rows, np.ndarray)
+            and all(isinstance(v, np.ndarray) for v in slot_rows.values())
+            and self._rowdma_ok()
+        ):
+            # host-gathered fault payloads only: staged rows are already on
+            # device, so there is no H2D copy left to fuse for them
+            return self._scatter_rowdma(
+                cache, idx, table_rows, slot_rows, n, b)
         idx_p = np.full(b, self.budget, np.int32)
         idx_p[:n] = np.asarray(idx)
 
@@ -487,37 +779,97 @@ class TieredTable:
 
     # -- write-back ----------------------------------------------------------
 
-    def _flush_slots(self, cache, slots: np.ndarray) -> None:
+    def _flush_slots(self, cache, slots: np.ndarray, *,
+                     sync: bool = False) -> None:
         """Device -> host write-back of specific cache slots into the master
-        (bucketed gather; padding reads slot 0 and is sliced off)."""
+        (bucketed gather; padding reads slot 0 and is sliced off).
+
+        The device gather is always dispatched here, before the slot can be
+        reused — ``gather_rows`` yields fresh output buffers, so the snapshot
+        survives the cache plane's later overwrite (or donation) regardless
+        of when it is read back. With a flusher attached (and ``sync`` not
+        forced), the D2H ``device_get`` + master scatter defer to the
+        background worker; otherwise they happen inline."""
         from swiftsnails_tpu.parallel.store import gather_rows
 
         n = int(slots.size)
         b = _pow2(max(n, 1))
         idx_p = np.zeros(b, np.int32)
         idx_p[:n] = slots
-        t_rows = np.asarray(jax.device_get(gather_rows(cache.table, idx_p)))[:n]
+        t_dev = gather_rows(cache.table, idx_p)
+        s_dev = {k: gather_rows(v, idx_p) for k, v in cache.slots.items()}
+        units = self.unit_of[slots].copy()
+        self.dirty[slots] = False
+        if self.flusher is not None and not sync:
+            if self._pending is None:
+                self._pending = np.zeros(self.master.units, np.uint8)
+            self._pending[units] = 1
+            t0 = time.monotonic_ns()
+            self.flusher.put(self, units, n, t_dev, s_dev)
+            self.stats.flush_wait_ns += time.monotonic_ns() - t0
+            return
+        self._land_flush([(units, n, t_dev, s_dev)])
+
+    def _land_flush(self, chunks: List[Tuple]) -> None:
+        """Land gathered flush chunks in the master: D2H the device
+        snapshots, scatter once per table (chunk units are disjoint — at
+        most one in-flight entry per unit — so the concatenation satisfies
+        ``scatter``'s unique-units contract), then bump generations and
+        clear the pending marks, in that order: a concurrent stage either
+        reads the pre-bump version (discarded at install) or sees the
+        post-scatter master."""
+        t0 = time.monotonic_ns()
+        units = np.concatenate([c[0] for c in chunks])
+        t_rows = np.concatenate(
+            [np.asarray(jax.device_get(c[2]))[:c[1]] for c in chunks])
         s_rows = {
-            k: np.asarray(jax.device_get(gather_rows(v, idx_p)))[:n]
-            for k, v in cache.slots.items()
+            k: np.concatenate(
+                [np.asarray(jax.device_get(c[3][k]))[:c[1]] for c in chunks])
+            for k in chunks[0][3]
         }
-        self.master.scatter(self.unit_of[slots], t_rows, s_rows)
+        self.master.scatter(units, t_rows, s_rows)
         # bump AFTER the scatter: a staging-thread version read that races the
         # write-back sees the old generation and the install discards its row
-        self.master_ver[self.unit_of[slots]] += 1
+        self.master_ver[units] += 1
+        if self._pending is not None:
+            self._pending[units] = 0
         self.stats.d2h_bytes += t_rows.nbytes + sum(
             v.nbytes for v in s_rows.values())
         self.stats.flushes += 1
-        self.stats.flushed_rows += n
-        self.dirty[slots] = False
+        self.stats.flushed_rows += int(units.size)
+        self.stats.flush_ns += time.monotonic_ns() - t0
+
+    def drain(self) -> None:
+        """Barrier: wait out every queued async flush (no-op when sync)."""
+        if self.flusher is not None:
+            t0 = time.monotonic_ns()
+            self.flusher.drain()
+            self.stats.flush_wait_ns += time.monotonic_ns() - t0
 
     def flush(self, cache) -> None:
         """Write every dirty slot back to the master. After this the master
         holds the exact resident-table content (the write-back invariant);
-        the cache stays mapped, so training continues without refaulting."""
+        the cache stays mapped, so training continues without refaulting.
+        Queued async flushes are drained first, then the remaining dirty
+        slots go back synchronously — this is a barrier, not an enqueue."""
+        self.drain()
+        if self.transparent:
+            # pass-through mode never marks dirty per step (prepare() skips
+            # ensure entirely), and the identity-mapped cache in unit order
+            # IS the whole table: replace the master planes wholesale (one
+            # D2H per plane, digests re-seeded) instead of a bucketed slot
+            # gather + per-unit scatter of everything
+            t0 = time.monotonic_ns()
+            self.master.reload(cache)
+            self.stats.flushes += 1
+            self.stats.flushed_rows += self.used
+            self.stats.d2h_bytes += self.master.table.nbytes + sum(
+                v.nbytes for v in self.master.slots.values())
+            self.stats.flush_ns += time.monotonic_ns() - t0
+            return
         d = np.nonzero(self.dirty)[0]
         if d.size:
-            self._flush_slots(cache, d)
+            self._flush_slots(cache, d, sync=True)
 
     def writeback_resident(self, cache) -> int:
         """Write EVERY resident slot back to the master, dirty or not — the
@@ -526,12 +878,35 @@ class TieredTable:
         of everything currently resident, so re-asserting it narrows the
         rollback to units that were evicted since that checkpoint. Returns
         the number of units written."""
+        self.drain()
         r = np.nonzero(self.unit_of >= 0)[0]
         if r.size:
-            self._flush_slots(cache, r)
+            self._flush_slots(cache, r, sync=True)
         return int(r.size)
 
     # -- admission seeding ----------------------------------------------------
+
+    def adopt_resident(self, state):
+        """Full-coverage adoption: the budget holds every master unit, so
+        the trainer's existing device plane IS the cache — install the
+        identity slot map over it and return it unchanged. No zero-fill, no
+        master gather, no H2D: the fast twin of ``make_cache`` + a full
+        :meth:`prewarm`, and the entry into transparent (pass-through)
+        mode."""
+        if self.budget < self.master.units:
+            raise ValueError(
+                f"tiered[{self.name}]: adopt_resident needs the budget "
+                f"({self.budget}) to cover every master unit "
+                f"({self.master.units})")
+        n = self.master.units
+        self.slot_of[:] = np.arange(n, dtype=np.int64)
+        self.unit_of[:n] = np.arange(n, dtype=np.int64)
+        self.used = n
+        self.ref[:n] = 3
+        self.stats.prewarmed_rows += n
+        if not self.read_only:
+            self.transparent = True
+        return state
 
     def prewarm(self, cache, units: np.ndarray):
         """Fault the given units (hottest-first) before step 0, clean. Takes
@@ -546,4 +921,11 @@ class TieredTable:
         cache = self.ensure(cache, units, mark_dirty=False)
         self.ref[self.slot_of[units]] = 3  # survive the first sweeps
         self.stats.prewarmed_rows += int(units.size)
+        if (not self.read_only and self.used == self.master.units
+                and self.budget == self.master.units
+                and np.array_equal(self.unit_of,
+                                   np.arange(self.budget, dtype=np.int64))):
+            # full coverage with the identity slot map: nothing can ever
+            # miss, so the tier degrades to a pass-through (see flush())
+            self.transparent = True
         return cache

@@ -34,19 +34,34 @@ def current_payload(value=123456.0):
     return p
 
 
-def test_probe_timeout_writes_structured_outage_event(isolated_bench, monkeypatch):
+def test_probe_timeout_kills_group_and_writes_structured_outage_event(
+        isolated_bench, monkeypatch):
+    killed = []
+
     class HungChild:
+        pid = 424242
         returncode = None
+        _calls = 0
 
         def communicate(self, timeout=None):
-            raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+            # first call hangs past the deadline; the post-kill reap returns
+            # the buffered stderr with the child now dead
+            HungChild._calls += 1
+            if HungChild._calls == 1:
+                raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+            self.returncode = -9
+            return "", "pjrt init stuck\n"
 
     monkeypatch.setattr(bench.subprocess, "Popen",
                         lambda *a, **kw: HungChild())
+    monkeypatch.setattr(bench.os, "killpg",
+                        lambda pgid, sig: killed.append((pgid, sig)))
     assert bench.probe_accelerator() is None
+    assert killed == [(424242, bench.signal.SIGKILL)]  # group, not just pid
     ev = Ledger(bench.LEDGER_PATH).latest("outage")
     assert ev is not None
-    assert ev["rc"] is None  # abandoned, never reaped
+    assert ev["killed"] is True and ev["rc"] == -9
+    assert ev["stderr_tail"] == ["pjrt init stuck"]
     assert isinstance(ev["probe_duration_s"], (int, float))
     assert "grant unavailable" in ev["error"]
     assert any("grant unavailable" in e for e in bench._state["errors"])
@@ -64,6 +79,10 @@ def test_probe_rc_failure_writes_outage_event(isolated_bench, monkeypatch):
     assert bench.probe_accelerator() is None
     ev = Ledger(bench.LEDGER_PATH).latest("outage")
     assert ev["rc"] == 17 and "rc=17" in ev["error"]
+    assert ev["killed"] is False
+    # the tail is a structured field now, not free text inside the error
+    assert ev["stderr_tail"] == ["boom: no TPU platform"]
+    assert "boom" not in ev["error"]
 
 
 def test_cached_fallback_rejects_corrupt_cache_and_regenerates(

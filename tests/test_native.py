@@ -288,6 +288,74 @@ def test_trainer_batches_use_pair_prefetcher(monkeypatch):
     assert all(b["centers"].shape[0] == 64 for b in batches)
 
 
+def _py_clock_sweep(ref, pinned, hand, n):
+    """Reference CLOCK sweep — the exact Python loop in
+    ``TieredTable._allocate``: skip pinned slots, age ``ref > 0`` slots by a
+    halving, select (and pin) ``ref == 0`` slots, hand wraps mod budget."""
+    budget = ref.shape[0]
+    victims = np.empty(n, np.int64)
+    k = 0
+    while k < n:
+        h = hand
+        hand = (hand + 1) % budget
+        if pinned[h]:
+            continue
+        if ref[h] > 0:
+            ref[h] >>= 1
+            continue
+        victims[k] = h
+        pinned[h] = True
+        k += 1
+    return victims, hand
+
+
+def test_tier_remap_matches_python():
+    rng = np.random.default_rng(5)
+    units, budget = 256, 64
+    slot_of = np.full(units, -1, np.int64)
+    resident = rng.choice(units, size=budget, replace=False)
+    slot_of[resident] = rng.permutation(budget)
+    rows = rng.choice(resident, size=1000).astype(np.int32)
+    out, bad = native.tier_remap(slot_of, rows)
+    assert bad == 0
+    np.testing.assert_array_equal(out, slot_of[rows].astype(np.int32))
+    # group > 1 (packed-small tiles): unit = row // group, lane preserved
+    g = 4
+    g_rows = (resident[rng.integers(0, budget, size=500)] * g
+              + rng.integers(0, g, size=500)).astype(np.int32)
+    out_g, bad_g = native.tier_remap(slot_of, g_rows, group=g)
+    assert bad_g == 0
+    want = (slot_of[g_rows // g] * g + g_rows % g).astype(np.int32)
+    np.testing.assert_array_equal(out_g, want)
+    # non-resident units are counted, never silently remapped
+    missing = np.setdiff1d(np.arange(units), resident)[:8].astype(np.int32)
+    _, bad_m = native.tier_remap(slot_of, missing)
+    assert bad_m == len(missing)
+
+
+def test_tier_clock_sweep_matches_python():
+    rng = np.random.default_rng(6)
+    for trial in range(5):
+        budget = int(rng.integers(8, 128))
+        ref_n = rng.integers(0, 8, size=budget).astype(np.uint8)
+        pin_n = rng.random(budget) < 0.25
+        pin_n[: budget // 2] = False  # enough evictable slots to terminate
+        ref_p, pin_p = ref_n.copy(), pin_n.copy()
+        pin0 = pin_n.copy()
+        hand = int(rng.integers(0, budget))
+        n = int(rng.integers(1, max(budget // 4, 2)))
+        v_n, h_n = native.tier_clock_sweep(ref_n, pin_n, hand, n)
+        v_p, h_p = _py_clock_sweep(ref_p, pin_p, hand, n)
+        np.testing.assert_array_equal(v_n, v_p)
+        assert h_n == h_p
+        # the sweep's side effects (aged counters, new pins) match too
+        np.testing.assert_array_equal(ref_n, ref_p)
+        np.testing.assert_array_equal(pin_n, pin_p)
+        # originally-pinned slots are never selected; victims were cold
+        assert not pin0[v_n].any()
+        assert np.all(ref_n[v_n] == 0)
+
+
 def test_read_ctr_trailing_blank_lines(tmp_path):
     """Blank/garbage lines after the last valid row must not trip the
     overflow check (regression: the fill pass returned -row and the wrapper

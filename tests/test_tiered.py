@@ -107,6 +107,141 @@ def test_stale_staged_row_is_discarded():
     np.testing.assert_array_equal(got, np.full(2, 99.0, np.float32))
 
 
+# ---------------------------------------------- async write-back races -----
+
+
+def test_async_refault_waits_for_inflight_flush():
+    """Refaulting a unit whose eviction flush is still queued must BLOCK on
+    the drain barrier, then re-gather the flushed (updated) value — grabbing
+    the master copy early would resurrect the pre-update row."""
+    import threading
+
+    from swiftsnails_tpu.tiered.store import (
+        HostMaster, TieredTable, _FlushQueue,
+    )
+
+    master = HostMaster(
+        TableState(table=jnp.arange(16, dtype=jnp.float32).reshape(8, 2),
+                   slots={}),
+        "dense")
+    fq = _FlushQueue(depth=8, batch=8)
+    tt = TieredTable(master, 2, name="t", flusher=fq)
+    try:
+        cache = tt.ensure(tt.make_cache(), np.array([0, 1]))  # both dirty
+        # the step "trained" unit 0: cache row diverges from the master row
+        cache = cache._replace(
+            table=cache.table.at[tt.slot_of[0]].set(7.0))
+        fq.pause()  # freeze the worker: the next flush stays queued
+        cache = tt.ensure(cache, np.array([2]))  # evicts unit 0 -> enqueue
+        assert tt.slot_of[0] < 0 and tt._pending is not None
+        assert tt._pending[0] == 1  # unit 0's flush is in flight
+
+        out = {}
+        done = threading.Event()
+
+        def refault():
+            out["cache"] = tt.ensure(cache, np.array([0]))
+            done.set()
+
+        t = threading.Thread(target=refault, daemon=True)
+        t.start()
+        assert not done.wait(0.25)  # blocked on the drain barrier
+        fq.resume()
+        assert done.wait(5.0), "refault never unblocked after the flush landed"
+        t.join(5.0)
+        got = np.asarray(out["cache"].table)[tt.slot_of[0]]
+        np.testing.assert_array_equal(got, np.full(2, 7.0, np.float32))
+        np.testing.assert_array_equal(master.table[0],
+                                      np.full(2, 7.0, np.float32))
+    finally:
+        fq.resume()
+        fq.close()
+
+
+@pytest.mark.parametrize("meshed", [False, True])
+@pytest.mark.parametrize("packed", [0, 1])
+def test_async_flush_eviction_parity_matrix(packed, meshed):
+    """Bit-parity under constant eviction with the background flusher ON,
+    across the layout x mesh matrix: dense and packed word2vec tables, one
+    device and an 8-device (2x4) mesh."""
+    mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4}) if meshed else None
+    bs = 2 if meshed else 1
+    if packed:
+        # packed rows are 128-lane padded: the budget must be sized by the
+        # packed stride, not dim. pool negatives keep the per-step working
+        # set (batch + pool blocks) under the 24-unit budget.
+        corpus = paired_corpus(n_pairs=32, reps=200, seed=0)  # 64 words
+        over = {"packed": 1, "pool_size": 16,
+                "tier_hbm_budget_mb": 2 * 24 * 128 * 4 / float(1 << 20)}
+    else:
+        corpus = paired_corpus(n_pairs=8, reps=400, seed=0)  # 16 words
+        over = {"tier_hbm_budget_mb": _budget_mb(4 if meshed else 2, 8)}
+    steps = 16
+    resident = TrainLoop(
+        _make(corpus=corpus, mesh=mesh, batch_size=bs,
+              **{k: v for k, v in over.items() if k != "tier_hbm_budget_mb"}),
+        log_every=0).run(seed=0, max_steps=steps)
+    loop = TrainLoop(
+        _make(corpus=corpus, mesh=mesh, batch_size=bs, table_tier="host",
+              tier_async_flush=1, **over),
+        log_every=0)
+    tiered = loop.run(seed=0, max_steps=steps)
+    s = loop.tier.summary()
+    assert s["async_flush"] is True
+    assert s["evictions"] > 0, s  # the budget actually bound
+    assert s["flushed_rows"] > 0, s
+    assert _tables_equal(resident, tiered)
+
+
+def test_transparent_full_budget_passthrough():
+    """A budget that covers the whole vocab enters pass-through mode: the
+    identity-mapped device plane IS the cache, no step ever faults or
+    evicts, and parity still holds through the end-of-run wholesale flush."""
+    steps = 16
+    resident = TrainLoop(_make(), log_every=0).run(seed=0, max_steps=steps)
+    loop = TrainLoop(_make(tier_slots=16), log_every=0)  # 16-word vocab
+    tiered = loop.run(seed=0, max_steps=steps)
+    s = loop.tier.summary()
+    assert s["transparent"] is True
+    assert s["transparent_steps"] >= steps
+    assert s["faulted_rows"] == 0 and s["evictions"] == 0
+    assert s["flushed_rows"] > 0  # the end-of-run wholesale write-back
+    assert _tables_equal(resident, tiered)
+
+
+def test_rowdma_install_matches_master_rows():
+    """The Pallas rowdma slot-install path (interpret mode off-TPU): faulted
+    rows of a packed ``[C, S, 128]`` master land in the cache plane via the
+    fused staging buffer + ``scatter_write_rows``, identical to the master's
+    rows — for the table plane and the optimizer slot plane both."""
+    from swiftsnails_tpu.parallel.store import PackedTableState
+    from swiftsnails_tpu.tiered.store import HostMaster, TieredTable
+
+    rng = np.random.default_rng(9)
+    C, S = 32, 2
+    table = rng.normal(size=(C, S, 128)).astype(np.float32)
+    accum = rng.normal(size=(C, S, 128)).astype(np.float32)
+    master = HostMaster(
+        PackedTableState(table=jnp.asarray(table),
+                         slots={"accum": jnp.asarray(accum)}),
+        "packed")
+    tt = TieredTable(master, 8, name="t")
+    tt.rowdma_interpret = True  # force the kernel path off-TPU
+    units = np.array([3, 11, 20, 31])
+    cache = tt.ensure(tt.make_cache(), units)
+    assert tt._rowdma is True  # the kernel path was actually eligible
+    slots = tt.slot_of[units]
+    np.testing.assert_array_equal(np.asarray(cache.table)[slots],
+                                  table[units])
+    np.testing.assert_array_equal(np.asarray(cache.slots["accum"])[slots],
+                                  accum[units])
+    # a second fault through the same reusable staging buffer size
+    more = np.array([0, 7])
+    cache = tt.ensure(cache, more)
+    np.testing.assert_array_equal(
+        np.asarray(cache.table)[tt.slot_of[more]], table[more])
+
+
 # ---------------------------------------------- checkpoint / cross-mesh ----
 
 
@@ -164,6 +299,32 @@ def test_checkpoint_matches_resident_checkpoint_bytes(tmp_path):
             np.asarray(a[name]["table"]), np.asarray(b[name]["table"]))
 
 
+def test_async_flush_checkpoint_bytes_match_sync_control(tmp_path):
+    """Drain-on-checkpoint: with the background flusher live, a mid-run save
+    and the final save must be byte-identical to a synchronous-flush control
+    run — the manifest barrier may never race a queued write-back."""
+    from swiftsnails_tpu.framework.checkpoint import load_tables
+
+    corpus = paired_corpus(n_pairs=8, reps=400, seed=0)
+    steps = 12
+    roots = {}
+    for tag, async_flush in (("sync", 0), ("async", 1)):
+        root = str(tmp_path / tag)
+        loop = TrainLoop(
+            _make(tier_slots=3, corpus=corpus, tier_async_flush=async_flush,
+                  param_backup_root=root, param_backup_period=steps // 2),
+            log_every=0)
+        loop.run(seed=0, max_steps=steps)
+        assert loop.tier.summary()["async_flush"] is bool(async_flush)
+        roots[tag] = root
+    for step in (steps // 2, steps):
+        a, _ = load_tables(roots["sync"], step=step)
+        b, _ = load_tables(roots["async"], step=step)
+        for name in a:
+            np.testing.assert_array_equal(
+                np.asarray(a[name]["table"]), np.asarray(b[name]["table"]))
+
+
 # ---------------------------------------------- chaos: preempt + resume ----
 
 
@@ -193,7 +354,8 @@ def test_preempt_drill_with_host_tier_resume_parity_zero(tmp_path):
     # drill exercises prewarm/fault/flush/resume, not eviction (the
     # tiny-budget tests own that axis)
     tier = {"table_tier": "host",
-            "tier_hbm_budget_mb": _budget_mb(128, 16)}
+            "tier_hbm_budget_mb": _budget_mb(128, 16),
+            "tier_async_flush": 1}
 
     control_tr = make_trainer(workdir, **tier)
     _, control_state, _ = run_loop(control_tr, max_steps=steps)

@@ -48,6 +48,11 @@ def test_tiered_lane_smoke(isolated_bench):
     assert ob["serve_pull_ok"] is True
     assert ob["round_trip_ok"] is True
     assert block["round_trip_ok"] is True
+    # the step-time breakdown block (plan/fault/flush/remap/h2d + queue depth)
+    bd = block["breakdown"]
+    for key in ("plan_ns", "fault_ns", "flush_ns", "remap_ns", "h2d_ns",
+                "flush_wait_ns", "flush_queue_depth"):
+        assert key in bd, bd
     # the block reaches the emitted JSON line (-> ledger payload)
     payload = json.loads(bench._result_json())
     assert payload["tiered"]["words_per_sec"] == block["words_per_sec"]
@@ -69,9 +74,12 @@ def _bench_record(value, tiered=None, platform="tpu"):
     return {"payload": payload}
 
 
-def _tiered_block(wps, parity=True, round_trip=True):
-    return {"words_per_sec": wps, "parity_bit_identical": parity,
-            "round_trip_ok": round_trip}
+def _tiered_block(wps, parity=True, round_trip=True, ratio=None):
+    block = {"words_per_sec": wps, "parity_bit_identical": parity,
+             "round_trip_ok": round_trip}
+    if ratio is not None:
+        block["tiered_over_resident"] = ratio
+    return block
 
 
 def test_check_regression_gates_tiered_words_per_sec(tmp_path):
@@ -115,6 +123,32 @@ def test_check_regression_tiered_ok_and_single_record(tmp_path):
     led.append("bench", _bench_record(10_000.0, _tiered_block(49_000.0)))
     rc, msg = check_regression(led, 10.0)
     assert rc == 1 and "REGRESSION" in msg.splitlines()[0]
+
+
+def test_check_regression_gates_tiered_resident_ratio(tmp_path):
+    """The equal-vocab tiered/resident speed ratio has a hard floor: a
+    newest record below 0.95x resident fails the gate even when absolute
+    words/sec looks healthy."""
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    led.append("bench", _bench_record(
+        100_000.0, _tiered_block(50_000.0, ratio=1.01)))
+    led.append("bench", _bench_record(
+        101_000.0, _tiered_block(51_000.0, ratio=0.88)))
+    rc, msg = check_regression(led, 10.0)
+    assert rc == 1 and "resident speed" in msg
+
+    # at or above the floor the ratio passes
+    led.append("bench", _bench_record(
+        102_000.0, _tiered_block(52_000.0, ratio=0.96)))
+    rc, msg = check_regression(led, 10.0)
+    assert rc == 0 and "tiered ok" in msg
+
+    # records predating the ratio field are not ratio-gated
+    led2 = Ledger(str(tmp_path / "l2.jsonl"))
+    led2.append("bench", _bench_record(100_000.0, _tiered_block(50_000.0)))
+    led2.append("bench", _bench_record(99_000.0, _tiered_block(49_000.0)))
+    rc, msg = check_regression(led2, 10.0)
+    assert rc == 0 and "tiered ok" in msg
 
 
 def test_check_regression_without_tiered_blocks_is_headline_only(tmp_path):

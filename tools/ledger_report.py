@@ -13,8 +13,11 @@ derived view of it. This tool renders the history — and gates CI:
     # bench gate: exit nonzero if the newest measured run is >10% below
     # the pinned baseline (default: best earlier measured ledger record;
     # pin explicitly with --baseline VALUE or --baseline-file FILE).
-    # Also gates the scaling lane's aggregate words/sec and the chaos
-    # lane's recovery (unrecovered drill / resume-parity breach fails CI).
+    # Also gates the scaling lane's aggregate words/sec, the chaos lane's
+    # recovery (unrecovered drill / resume-parity breach fails CI), and
+    # the tiered lane: bit-parity / round-trip failure is fatal on any
+    # platform, words/sec gates per platform, and the equal-vocab
+    # tiered/resident ratio has a hard 0.95x floor
     python tools/ledger_report.py --check-regression 10
 
     # failure timeline: outage / chaos-injection / black-box / checkpoint

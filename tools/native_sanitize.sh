@@ -22,7 +22,9 @@ run_mode() {
   echo "=== $name: pytest tests/test_native.py tests/test_streaming.py ==="
   # Preload the sanitizer runtime into python and point the bindings at the
   # instrumented build. test_streaming drives the chunked readers (token +
-  # CTR streams, byte-span splits) through the instrumented library.
+  # CTR streams, byte-span splits) through the instrumented library;
+  # test_native also covers the tiered-store entry points (tier_remap,
+  # tier_clock_sweep) against their Python references.
   local so="$OUT_DIR/libsnails_$name.so"
   # -k: the sanitizer surface is the NATIVE code — jax-training and
   # subprocess tests (trainer integration, constant-RSS) hang or crawl
