@@ -354,12 +354,16 @@ def probe_accelerator():
     return None
 
 
-def synth_corpus(n_tokens: int, vocab: int, seed: int = 0) -> np.ndarray:
-    """Zipf-ish token stream over [0, vocab) — text8-shaped frequencies."""
+def synth_corpus(n_tokens: int, vocab: int, seed: int = 0,
+                 s: float = 1.05) -> np.ndarray:
+    """Zipf-ish token stream over [0, vocab) — text8-shaped frequencies.
+
+    ``s`` is the zipf exponent: 1.05 (default) is text8-flat; the skewed
+    placement leg uses a steeper ``s`` so a small head carries most slots."""
     rng = np.random.default_rng(seed)
-    # zipf via inverse-CDF over harmonic weights (s=1.05, bounded support)
+    # zipf via inverse-CDF over harmonic weights (bounded support)
     ranks = np.arange(1, vocab + 1, dtype=np.float64)
-    w = 1.0 / ranks**1.05
+    w = 1.0 / ranks**s
     cdf = np.cumsum(w) / w.sum()
     u = rng.random(n_tokens)
     return np.searchsorted(cdf, u).astype(np.int32)
@@ -1056,6 +1060,158 @@ def measure_scaling(counts, ids, n_devices=None, comm_dtypes=SCALING_COMM_DTYPES
             _state["errors"].append(f"scaling overlap lane failed: {e}")
     _state["scaling"] = block
 
+    # zipf-skewed leg: uniform vs `placement: auto` exchange bytes at each
+    # wire format — the hybrid-placement acceptance lane
+    try:
+        measure_skewed_placement(
+            n_devices=n, comm_dtypes=comm_dtypes, dim=dim,
+            batch_per_shard=b_shard, steps_per_call=spc)
+    except Exception as e:
+        _state["errors"].append(
+            f"skewed placement leg failed ({type(e).__name__}: {e})")
+
+
+# zipf exponent of the skewed placement leg: steep enough that a ~1k-row
+# head covers most of the batch slots (the regime hybrid placement targets)
+SKEWED_ZIPF_S = 1.4
+SKEWED_VOCAB = 1024 if _SMALL else 4096
+
+
+def measure_skewed_placement(n_devices=None,
+                             comm_dtypes=SCALING_COMM_DTYPES, dim=None,
+                             batch_per_shard=None, steps_per_call=None,
+                             vocab_size=None) -> None:
+    """Attach the zipf-skewed uniform-vs-hybrid leg to ``_state['scaling']``.
+
+    A steep-zipf corpus (``s=SKEWED_ZIPF_S``) where vocab id == frequency
+    rank, so ``placement: auto`` can read the CDF. Per comm_dtype: compile
+    and audit the grouped-mesh step twice — uniform sharding, then the
+    auto-cut hybrid split calibrated with the uniform lane's measured
+    exchange bytes — and record the audited exchange-byte reduction plus a
+    short-run loss-parity check on identical batches/keys. Bytes come from
+    compiled HLO shapes (static), so the leg is valid on CPU;
+    ``ledger-report --check-regression`` gates reduction >= 2x.
+    """
+    import itertools
+
+    import jax
+
+    from swiftsnails_tpu.data.sampler import batch_stream, skipgram_windows
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh,
+    )
+    from swiftsnails_tpu.parallel.placement import PlacementManager
+    from swiftsnails_tpu.telemetry.audit import audit_step
+    from swiftsnails_tpu.utils.config import Config
+
+    scal = _state.get("scaling")
+    if not isinstance(scal, dict) or scal.get("skipped"):
+        return
+    devices = jax.devices()
+    n = min(n_devices or len(devices), len(devices))
+    if n < 2:
+        return
+    data, model = _scaling_mesh_shape(n)
+    dim = dim or DIM
+    b_shard = batch_per_shard or SCALING_BATCH_PER_SHARD
+    spc = steps_per_call or SCALING_STEPS_PER_CALL
+    macro_n = b_shard * data * spc
+    vocab_size = vocab_size or SKEWED_VOCAB
+    n_tokens = max(2 * macro_n, 16_000)
+    ids = synth_corpus(n_tokens, vocab_size, seed=23, s=SKEWED_ZIPF_S)
+    counts = np.bincount(ids, minlength=vocab_size).astype(np.int64)
+    # the zipf stream's id is already ~its frequency rank; sampling noise can
+    # swap neighbors, so re-rank exactly (auto's CDF cut assumes id == rank)
+    order = np.argsort(-counts, kind="stable")
+    inv = np.empty_like(order)
+    inv[order] = np.arange(vocab_size)
+    ids = inv[ids].astype(np.int32)
+    counts = counts[order]
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)],
+                  np.maximum(counts, 1))
+
+    rng = np.random.default_rng(29)
+    g_c, g_x = skipgram_windows(ids, WINDOW, rng)
+    batches = [
+        w for w in itertools.islice(batch_stream(g_c, g_x, macro_n, rng), 4)
+        if w["centers"].shape[0] == macro_n
+    ]
+    if not batches:
+        _state["errors"].append(
+            "skewed placement leg skipped: corpus too small for a "
+            f"{macro_n}-word macro batch")
+        return
+    mesh_n = make_mesh(
+        {DATA_AXIS: data, MODEL_AXIS: model}, devices=devices[:n])
+    bs = batch_sharding(mesh_n)
+    dev_batches = [
+        {k: jax.device_put(v, bs) for k, v in b.items()} for b in batches
+    ]
+
+    def lane(comm_dtype, placement, calib_bytes=None):
+        conf = _scaling_lane_config(
+            vocab_size, dim, macro_n // spc, spc, comm_dtype, overlap=False)
+        conf["placement"] = placement
+        if calib_bytes:
+            conf["placement_calib_bytes"] = str(int(calib_bytes))
+        trainer = Word2VecTrainer(
+            Config(conf), mesh=mesh_n, corpus_ids=np.zeros(2, np.int32),
+            vocab=vocab)
+        state = trainer.init_state()
+        pm = PlacementManager(trainer, mesh_n)
+        if pm.active:
+            state = pm.adopt(state)
+        step = jax.jit(trainer.train_step, donate_argnums=(0,))
+        key = jax.random.PRNGKey(3)
+        m = None
+        for i in range(4):  # compile + identical short run for loss parity
+            state, m = step(state, dev_batches[i % len(dev_batches)],
+                            jax.random.fold_in(key, i))
+        loss = float(m["loss"])
+        audit_report = audit_step(
+            step, state, dev_batches[0], jax.random.fold_in(key, 0))
+        exchange = sum((audit_report.get("by_scope") or {}).values()) or None
+        return trainer, exchange, loss, audit_report
+
+    per = {}
+    decision = None
+    for comm_dtype in comm_dtypes:
+        u_tr, u_x, u_loss, _u_audit = lane(comm_dtype, "uniform")
+        h_tr, h_x, h_loss, h_audit = lane(comm_dtype, "auto", calib_bytes=u_x)
+        entry = {
+            "uniform_exchange_bytes": u_x,
+            "hybrid_exchange_bytes": h_x,
+            "exchange_reduction": (
+                round(u_x / h_x, 3) if u_x and h_x else None),
+            "cut": h_tr.placement_cut,
+            "loss_uniform": _finite(u_loss, 6),
+            "loss_hybrid": _finite(h_loss, 6),
+            "loss_delta": _finite(
+                abs(h_loss - u_loss) / max(abs(u_loss), 1e-9), 6),
+        }
+        if h_audit.get("by_table"):
+            entry["by_table_bytes"] = dict(h_audit["by_table"])
+        per[comm_dtype] = entry
+        if decision is None:
+            decision = dict(h_tr.placement_decision or {})
+            if h_x:
+                decision["measured_exchange_bytes"] = h_x
+        print(
+            f"bench: scaling skewed[{comm_dtype}] exchange "
+            f"{u_x or 0:,} -> {h_x or 0:,} B/step "
+            f"({entry['exchange_reduction']}x, cut={h_tr.placement_cut}), "
+            f"loss_delta={entry['loss_delta']}",
+            file=sys.stderr,
+        )
+    scal["skewed"] = {
+        "zipf_s": SKEWED_ZIPF_S,
+        "vocab": vocab_size,
+        "per_dtype": per,
+        "decision": decision,
+    }
+
 
 # -- resilience (chaos) lane --------------------------------------------------
 #
@@ -1086,6 +1242,45 @@ def measure_chaos() -> None:
         f"loss parity {block.get('loss_parity')}",
         file=sys.stderr,
     )
+
+
+def run_scaling_lane() -> int:
+    """``--lane scaling``: the scale-out lane alone (incl. the zipf-skewed
+    uniform-vs-hybrid placement leg), one JSON line out."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "scaling"
+    _state["platform"] = jax.devices()[0].platform
+    n_tokens = 120_000 if _SMALL else 1_500_000
+    ids = synth_corpus(n_tokens, VOCAB, seed=5)
+    counts = np.maximum(np.bincount(ids, minlength=VOCAB), 1).astype(np.int64)
+    try:
+        measure_scaling(counts, ids)
+    except Exception as e:
+        _state["errors"].append(
+            f"scaling lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["scaling"]
+    if block.get("skipped"):
+        _emit_once()
+        return 1
+    # the lane's headline is the f32 aggregate words/sec across the mesh
+    _state["best"] = block.get("aggregate_words_per_sec") or 0.0
+    _state["best_path"] = "scaling-f32"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    sk = block.get("skewed") or {}
+    reductions = [
+        e.get("exchange_reduction")
+        for e in (sk.get("per_dtype") or {}).values()
+    ]
+    ok = bool(reductions) and all(
+        isinstance(r, (int, float)) and r >= 2.0 for r in reductions)
+    return 0 if ok else 1
 
 
 def run_chaos_lane() -> int:
@@ -1693,10 +1888,14 @@ def main(argv=None):
         prog="bench", description="word2vec words/sec/chip benchmark")
     parser.add_argument(
         "--lane",
-        choices=("full", "chaos", "serve", "tiered", "chaos-serve",
+        choices=("full", "scaling", "chaos", "serve", "tiered", "chaos-serve",
                  "chaos-cluster"),
         default="full",
-        help="full = the headline bench (default); chaos = the resilience "
+        help="full = the headline bench (default); scaling = the scale-out "
+             "lane alone (grouped-mesh 1-vs-N throughput per comm_dtype plus "
+             "the zipf-skewed uniform-vs-hybrid placement leg; exchange "
+             "bytes are compiled-HLO shapes, so valid on CPU); "
+             "chaos = the resilience "
              "lane alone (guardrail overhead + scripted-fault recovery "
              "drills; valid on CPU); serve = the read-path latency lane "
              "(pull/top-k/CTR-score qps + p50/p95/p99; valid on CPU); "
@@ -1713,6 +1912,8 @@ def main(argv=None):
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
     watchdog.daemon = True  # don't keep the process alive after success
     watchdog.start()
+    if args.lane == "scaling":
+        return run_scaling_lane()
     if args.lane == "chaos":
         return run_chaos_lane()
     if args.lane == "serve":

@@ -69,6 +69,28 @@ class Vocab:
         ranks[order] = np.arange(len(self.counts), dtype=np.int64)
         return ranks
 
+    def hottest_rows(self, k: Optional[int] = None) -> np.ndarray:
+        """Vocab ids ordered hottest-first (inverse of frequency_ranks).
+        Consumers: tiered prewarm (`tier_warm_rows`) and the placement
+        auto-partitioner's head candidates."""
+        order = np.argsort(self.frequency_ranks(), kind="stable")
+        return order if k is None else order[:k]
+
+    def cumulative_coverage(self) -> np.ndarray:
+        """CDF over frequency ranks: ``out[k]`` is the fraction of token
+        accesses covered by the ``k`` hottest rows (``out[0] == 0``,
+        ``out[len(vocab)] == 1``). The placement cost model reads the
+        coverage of a candidate head cut straight off this curve."""
+        hot = self.counts[self.hottest_rows()].astype(np.float64)
+        total = hot.sum()
+        cdf = np.cumsum(hot) / (total if total > 0 else 1.0)
+        return np.concatenate([[0.0], cdf])
+
+    def coverage_at(self, k: int) -> float:
+        """Fraction of accesses the ``k`` hottest rows cover."""
+        cdf = self.cumulative_coverage()
+        return float(cdf[min(max(int(k), 0), len(cdf) - 1)])
+
     def encode(self, tokens: Iterable[str]) -> np.ndarray:
         """Token stream -> int32 ids, dropping OOV (word2vec convention)."""
         idx = self.index

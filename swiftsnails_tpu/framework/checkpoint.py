@@ -283,6 +283,7 @@ def save_checkpoint(
     ledger=None,
     tier=None,
     retry=None,
+    placement=None,
 ) -> str:
     """Write a sharded checkpoint for ``step`` under ``root`` (param_backup
     parity), committed by a checksum manifest.
@@ -313,6 +314,12 @@ def save_checkpoint(
     if tier is not None:
         state = tier.master_state(state)
         wait = True
+    if placement is not None:
+        # hybrid head/tail planes -> the uniform master layout (eager,
+        # value-preserving concat into NEW buffers, so the async write path
+        # stays safe): on disk a hybrid run is byte-identical to a uniform
+        # one and restore/serving need no placement awareness
+        state = placement.master_state(state)
     path = _step_dir(root, step)
     manifest = build_manifest(state, step, cursor=cursor, config_hash=config_hash)
     ckptr = _checkpointer()

@@ -123,6 +123,14 @@ class Trainer:
         prewarm (seeded from corpus frequency ranks); ``None`` to skip."""
         return None
 
+    # -- hybrid-placement hook (placement: hybrid|auto; parallel/hybrid.py) --
+
+    def placement_spec(self) -> Optional[Dict[str, Dict]]:
+        """``{table_name: {"cut": K, "group": G}}`` head/tail split per table
+        (names match :meth:`tier_tables`); ``None``/empty means uniform
+        placement and the loop pays nothing."""
+        return None
+
 
 class _Prefetcher:
     """Bounded background-thread batch prefetch (``queue_with_capacity``
@@ -287,6 +295,7 @@ class TrainLoop:
                     config_hash=self.config_hash,
                     keep=self.backup_keep, protect=self._restored_step,
                     ledger=self.ledger, tier=self.tier, retry=ckpt_retry,
+                    placement=self.placement,
                 )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
@@ -361,6 +370,14 @@ class TrainLoop:
                 trainer, registry=self.registry, tracer=self.tracer)
         else:
             self.tier = None
+        # placement: hybrid|auto -> head/tail hybrid split of the sparse
+        # tables (parallel/placement.py): the zipf head lives replicated, the
+        # tail keeps the model-sharded collectives. Inactive (uniform, no
+        # mesh, tiered, or a zero cut) => None and the loop pays nothing.
+        from swiftsnails_tpu.parallel.placement import PlacementManager
+
+        pm = PlacementManager(trainer, trainer.mesh)
+        self.placement = pm if pm.active else None
         # tier integrity sweep cadence (steps; 0 = only at heal requests).
         # Runs on the resilient path only — like chaos/guardrail, arming it
         # costs the plain hot path nothing.
@@ -453,6 +470,11 @@ class TrainLoop:
             # (prewarmed with the vocab's hottest rows); from here on `state`
             # carries the small cache planes until master_state() at the end
             state = tier.adopt(state)
+        if self.placement is not None:
+            # uniform master layout -> head/tail hybrid planes (eager,
+            # value-preserving; runs AFTER resume so a uniform-layout
+            # checkpoint restores transparently into a hybrid run)
+            state = self.placement.adopt(state)
         depth = trainer.config.get_int("prefetch_batches", 2)
         cl = self.cluster
         if cl is not None:
@@ -650,6 +672,10 @@ class TrainLoop:
             # the caller the full-size master-backed state (same pytree type,
             # shapes, dtypes as a resident run — export/eval are unchanged)
             state = tier.master_state(state)
+        if self.placement is not None:
+            # head/tail planes -> uniform layout: callers (export, eval,
+            # serving snapshots) only ever see the master layout
+            state = self.placement.master_state(state)
         host = {}
         if step % max(self.log_every, 1) != 0 or not self.log_every:
             host = {k: float(v) for k, v in last_metrics.items()} if last_metrics else {}
@@ -872,6 +898,20 @@ class TrainLoop:
                     record["chaos"] = self.chaos.summary()
                 if self.tier is not None:
                     record["tiered"] = self.tier.summary()
+                placement_decision = getattr(
+                    self.trainer, "placement_decision", None)
+                if placement_decision:
+                    # the cut decision (or the uniform-fallback reason) —
+                    # rendered by `ledger-report` run lines; when the comm
+                    # audit ran, pin the measured exchange bytes next to the
+                    # cost model's prediction
+                    pl = dict(placement_decision)
+                    if audit is not None:
+                        if isinstance(audit.get("total_bytes"), int):
+                            pl["measured_exchange_bytes"] = audit["total_bytes"]
+                        if audit.get("by_table"):
+                            pl["measured_by_table"] = dict(audit["by_table"])
+                    record["placement"] = pl
                 if self.preempted:
                     record["preempted"] = True
                 self.ledger.append(

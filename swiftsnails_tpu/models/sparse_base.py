@@ -14,6 +14,7 @@ Config keys: ``num_fields``, ``capacity``, ``learning_rate``, ``optimizer``
 
 from __future__ import annotations
 
+import contextlib
 import logging
 from typing import Any, Dict, Iterator, NamedTuple, Optional, Tuple
 
@@ -123,6 +124,12 @@ class SparseCTRTrainer(Trainer):
 
         self.comm_dtype = resolve_comm_dtype(
             cfg.get_str("comm_dtype", "float32"))
+        # placement: uniform|hybrid|auto — head/tail hybrid placement of the
+        # hashed table (parallel/hybrid.py). CTR row ids are hash outputs, so
+        # `auto` (which needs frequency-rank prefix structure) resolves to
+        # uniform; explicit `hybrid` replicates the first
+        # `placement_head_rows` hash slots (parity/composition testing).
+        self._init_placement(cfg)
         self.dense_opt = (
             optax.adagrad(self.dense_lr) if opt_name == "adagrad" else optax.sgd(self.dense_lr)
         )
@@ -159,6 +166,74 @@ class SparseCTRTrainer(Trainer):
                 from swiftsnails_tpu.parallel.cluster import shard_rows
 
                 self.labels, self.feats = shard_rows(self.labels, self.feats)
+
+    # -- placement (hybrid head/tail split; see parallel/placement.py) -------
+
+    def _init_placement(self, cfg: Config) -> None:
+        from swiftsnails_tpu.parallel.placement import resolve_placement
+
+        mode = resolve_placement(cfg.get_str("placement", "uniform"))
+        self.placement_cut = 0
+        self.placement_decision = None
+        if mode == "uniform":
+            return
+        log = logging.getLogger(__name__)
+
+        def resolve_uniform(reason: str) -> None:
+            log.warning("placement: %s requested but %s; staying uniform",
+                        mode, reason)
+            self.placement_decision = {
+                "mode": "uniform", "requested": mode, "cut": 0,
+                "replicated_rows": 0, "reason": reason}
+
+        if self.mesh is None:
+            return resolve_uniform("no mesh (single device is already local)")
+        if self.tiered:
+            return resolve_uniform("table_tier: host already caches the hot head")
+        if mode == "auto":
+            # hash_row() destroys the frequency-rank prefix structure the
+            # zipf-cut cost model reads, so there is no principled cut here
+            return resolve_uniform("hashed row ids carry no frequency order")
+        from swiftsnails_tpu.parallel.mesh import MODEL_AXIS
+
+        model = self.mesh.shape[MODEL_AXIS]
+        if self.packed:
+            from swiftsnails_tpu.parallel.store import small_group
+
+            # head tiles must align with tile-granular model ownership
+            align = small_group(self.table_dim) * model
+        else:
+            align = model
+        cut = cfg.get_int("placement_head_rows", 0) or min(
+            1024, self.capacity // 2)
+        cut = min(int(cut), self.capacity // 2)
+        cut -= cut % align
+        if cut <= 0:
+            return resolve_uniform(f"head cut rounds to 0 at alignment {align}")
+        self.placement_cut = cut
+        self.placement_decision = {
+            "mode": "hybrid", "requested": mode, "cut": cut,
+            "replicated_rows": cut, "coverage": 0.0}
+        log.info("placement: hybrid head cut=%d (align %d) on hashed table",
+                 cut, align)
+
+    def placement_spec(self):
+        """Table name -> {cut, group} for PlacementManager.adopt."""
+        if not self.placement_cut:
+            return None
+        if self.packed:
+            from swiftsnails_tpu.parallel.store import small_group
+
+            g = small_group(self.table_dim)
+        else:
+            g = 1
+        return {"table": {"cut": self.placement_cut, "group": g}}
+
+    def _tbl_scope(self):
+        """Comm-audit attribution scope (telemetry/audit.py by_table)."""
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        return jax.named_scope("ssn_tbl_table")
 
     # -- subclass API ------------------------------------------------------
 
@@ -213,37 +288,73 @@ class SparseCTRTrainer(Trainer):
 
     def _pull_rows(self, table_state, rows: jax.Array) -> jax.Array:
         """[N] row ids -> [N, table_dim] values on the active data plane."""
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
+
         if self.packed:
             if self.mesh is not None:
-                from swiftsnails_tpu.parallel.transfer import (
-                    pull_collective_packed_small,
-                )
+                with self._tbl_scope():
+                    if is_hybrid(table_state):
+                        from swiftsnails_tpu.parallel.hybrid import (
+                            pull_hybrid_packed_small,
+                        )
 
-                return pull_collective_packed_small(
-                    self.mesh, table_state, rows, self.table_dim,
-                    comm_dtype=self.comm_dtype,
-                )
+                        return pull_hybrid_packed_small(
+                            self.mesh, table_state, rows, self.table_dim,
+                            comm_dtype=self.comm_dtype,
+                        )
+                    from swiftsnails_tpu.parallel.transfer import (
+                        pull_collective_packed_small,
+                    )
+
+                    return pull_collective_packed_small(
+                        self.mesh, table_state, rows, self.table_dim,
+                        comm_dtype=self.comm_dtype,
+                    )
             from swiftsnails_tpu.parallel.store import pull_packed_small
 
             return pull_packed_small(table_state, rows, self.table_dim)
+        if is_hybrid(table_state):
+            from swiftsnails_tpu.parallel.hybrid import pull_hybrid
+
+            with self._tbl_scope():
+                return pull_hybrid(self.mesh, table_state, rows,
+                                   comm_dtype=self.comm_dtype)
         return pull(table_state, rows)
 
     def _push_rows(self, table_state, rows, grads, lr):
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
+
         if self.packed:
             if self.mesh is not None:
-                from swiftsnails_tpu.parallel.transfer import (
-                    push_collective_packed_small,
-                )
+                with self._tbl_scope():
+                    if is_hybrid(table_state):
+                        from swiftsnails_tpu.parallel.hybrid import (
+                            push_hybrid_packed_small,
+                        )
 
-                return push_collective_packed_small(
-                    self.mesh, table_state, rows, grads, self.access, lr,
-                    self.table_dim, comm_dtype=self.comm_dtype,
-                )
+                        return push_hybrid_packed_small(
+                            self.mesh, table_state, rows, grads, self.access,
+                            lr, self.table_dim, comm_dtype=self.comm_dtype,
+                        )
+                    from swiftsnails_tpu.parallel.transfer import (
+                        push_collective_packed_small,
+                    )
+
+                    return push_collective_packed_small(
+                        self.mesh, table_state, rows, grads, self.access, lr,
+                        self.table_dim, comm_dtype=self.comm_dtype,
+                    )
             from swiftsnails_tpu.parallel.store import push_packed_small
 
             return push_packed_small(
                 table_state, rows, grads, self.access, lr, self.table_dim
             )
+        if is_hybrid(table_state):
+            from swiftsnails_tpu.parallel.hybrid import push_hybrid
+
+            with self._tbl_scope():
+                return push_hybrid(self.mesh, table_state, rows, grads,
+                                   self.access, lr, comm_dtype=self.comm_dtype)
         return push(table_state, rows, grads, self.access, lr)
 
     def _row_chunks(self, rows_per_chunk: int = 1 << 20):

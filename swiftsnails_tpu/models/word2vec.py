@@ -21,6 +21,7 @@ Config keys: ``dim``, ``window``, ``negatives``, ``learning_rate``,
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import logging
 from typing import Dict, Iterator, NamedTuple, Optional, Tuple
@@ -294,6 +295,13 @@ class Word2VecTrainer(Trainer):
             )
         self.access = SgdAccess()
         self.neg_alias = build_unigram_alias(vocab.counts)
+        # placement: uniform|hybrid|auto — hybrid head/tail split of both
+        # tables: the zipf head replicated (dense grad reduce over `data`),
+        # the tail model-sharded through the collective twins in tail slot
+        # space (parallel/hybrid.py). `auto` picks the cut from the vocab
+        # frequency CDF + the calibrated wire-cost model
+        # (parallel/placement.py); see docs/SCALING.md.
+        self._init_placement(cfg)
         self._plan_fns = {}  # (substeps, neg shape) -> jitted tier planner
         if self.resident:
             # surface the kernel's rounding so operators see what actually
@@ -345,6 +353,123 @@ class Word2VecTrainer(Trainer):
             return keys
         return self._rows(keys)
 
+    # -- placement (hybrid head/tail split; parallel/hybrid.py) --------------
+
+    def _init_placement(self, cfg) -> None:
+        from swiftsnails_tpu.parallel.placement import resolve_placement
+
+        requested = resolve_placement(cfg.get_str("placement", "uniform"))
+        self.placement = requested
+        self.placement_head_rows = cfg.get_int("placement_head_rows", 0)
+        self.placement_slack = cfg.get_float("placement_tail_slack", 2.0)
+        self.placement_cut = 0
+        self.placement_cov = 0.0
+        self.placement_decision = None
+        if requested == "uniform":
+            return
+        log = logging.getLogger(__name__)
+
+        def resolve_uniform(reason: str) -> None:
+            log.warning("placement: %s requested but %s; running uniform",
+                        requested, reason)
+            self.placement = "uniform"
+            self.placement_decision = {
+                "mode": "uniform", "requested": requested, "cut": 0,
+                "replicated_rows": 0, "reason": reason,
+            }
+
+        if self.mesh is None:
+            # nothing to replicate against — and no collectives to save
+            return resolve_uniform("no mesh")
+        if self.tiered:
+            # both remap row ids host-side; composing the two remaps is out
+            # of scope — the tiered store already keeps the head HBM-resident
+            return resolve_uniform(
+                "table_tier: host already caches the hot head")
+        from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+        model = self.mesh.shape[MODEL_AXIS]
+        data = self.mesh.shape[DATA_AXIS]
+        calib = cfg.get_float("placement_calib_bytes", 0.0)
+        decision = {"requested": requested,
+                    "measured_uniform_bytes": calib or None}
+        if requested == "auto":
+            if self.hash_keys:
+                # hashed ids are not frequency ranks: a prefix cut is an
+                # arbitrary row set, so the CDF-driven cut has no meaning
+                return resolve_uniform(
+                    "hash_keys scrambles frequency ranks (explicit "
+                    "placement: hybrid still works)")
+            from swiftsnails_tpu.parallel.placement import choose_cut
+
+            n = self.batch_size
+            if self.packed:
+                pc = self._effective_pc(n)
+                local_slots = max(
+                    (n * 2 * self.window + (n // pc) * self.pool_size) // data,
+                    1)
+                row_elems = -(-self.dim // 128) * 128
+            else:
+                local_slots = max(n * (1 + self.negatives) // data, 1)
+                row_elems = self.dim
+            decision.update(choose_cut(
+                self.vocab.counts, self.capacity, align=model,
+                local_slots=local_slots, row_elems=row_elems, data=data,
+                slack=self.placement_slack, comm_dtype=self.comm_dtype,
+                measured_uniform_bytes=calib or None,
+            ))
+            cut = decision["cut"]
+        else:
+            cut = self.placement_head_rows or min(1024, self.capacity // 2)
+        cut = min(int(cut), self.capacity // 2)
+        cut -= cut % model
+        if cut <= 0:
+            resolve_uniform("cut resolved to 0 (flat distribution or "
+                            "head smaller than the model axis)")
+            self.placement_decision.update(
+                {k: v for k, v in decision.items() if k != "requested"})
+            return
+        self.placement_cut = cut
+        self.placement_cov = (
+            0.0 if self.hash_keys else self.vocab.coverage_at(cut))
+        decision.update({
+            "mode": "hybrid", "cut": cut,
+            "replicated_rows": 2 * cut,  # both tables split at the same cut
+            "coverage": self.placement_cov,
+        })
+        self.placement_decision = decision
+        log.info("placement: hybrid cut=%d (coverage %.3f, requested %s)",
+                 cut, self.placement_cov, requested)
+
+    def placement_spec(self):
+        """Per-table split spec for PlacementManager (None = uniform)."""
+        if not self.placement_cut:
+            return None
+        return {
+            "in_table": {"cut": self.placement_cut, "group": 1},
+            "out_table": {"cut": self.placement_cut, "group": 1},
+        }
+
+    def _hybrid_cap(self, n_rows: int) -> int:
+        """Static unique capacity for a hybrid tail pull/push over
+        ``n_rows`` global rows: the head's coverage says how few distinct
+        tail rows a batch can touch, so the dedup payload shrinks to
+        ``slack * (1 - coverage)`` of the local slot count — the structural
+        wire-byte cut of the hybrid layout."""
+        override = self.config.get_int("placement_tail_cap", 0)
+        if override:
+            return override
+        from swiftsnails_tpu.parallel.mesh import DATA_AXIS
+        from swiftsnails_tpu.parallel.placement import tail_cap
+
+        d = self.mesh.shape[DATA_AXIS]
+        return tail_cap(max(n_rows // d, 1), self.placement_cov,
+                        self.placement_slack)
+
+    def _tbl_scope(self, tbl):
+        return (jax.named_scope(f"ssn_tbl_{tbl}") if tbl
+                else contextlib.nullcontext())
+
     def _mesh_safe_cat(self, parts):
         """Leading-axis concatenate that survives GSPMD on a (data, model)
         mesh. GSPMD on this jax/XLA line assembles a ``concatenate`` of
@@ -376,14 +501,29 @@ class Word2VecTrainer(Trainer):
         return self._mesh_safe_cat(list(parts))
 
     # packed pull/push dispatch: single-device kernels, or shard_map
-    # collectives wrapping the same kernels when a mesh is present
-    def _ppull(self, table_state, rows):
+    # collectives wrapping the same kernels when a mesh is present; hybrid
+    # table states route through the head/tail twins (parallel/hybrid.py)
+    def _ppull(self, table_state, rows, tbl=None):
         if self.mesh is None:
             return pull_packed(table_state, rows)
-        from swiftsnails_tpu.parallel.transfer import pull_collective_packed
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
 
-        return pull_collective_packed(
-            self.mesh, table_state, rows, comm_dtype=self.comm_dtype)
+        with self._tbl_scope(tbl):
+            if is_hybrid(table_state):
+                from swiftsnails_tpu.parallel.hybrid import pull_hybrid_packed
+
+                # index/overflow are discarded: the matching push recomputes
+                # the same deterministic unique list and counts the overflow
+                # once there
+                vals, _, _ = pull_hybrid_packed(
+                    self.mesh, table_state, rows,
+                    self._hybrid_cap(rows.shape[0]),
+                    comm_dtype=self.comm_dtype)
+                return vals
+            from swiftsnails_tpu.parallel.transfer import pull_collective_packed
+
+            return pull_collective_packed(
+                self.mesh, table_state, rows, comm_dtype=self.comm_dtype)
 
     def _comm_seed(self, rng):
         """uint32 dither seed for int8 stochastic rounding (None unless the
@@ -394,27 +534,46 @@ class Word2VecTrainer(Trainer):
 
         return seed_from_key(rng)
 
-    def _ppush(self, table_state, rows, grads, lr, seed=None):
+    def _ppush(self, table_state, rows, grads, lr, seed=None, tbl=None):
         """Returns ``(new_table_state, dropped)`` — dropped is always 0 except
-        in bucketed push mode (static bucket overflow, see transfer.py)."""
+        in bucketed push mode (static bucket overflow, see transfer.py) and
+        hybrid placement (tail unique-capacity overflow, hybrid.py)."""
         if self.mesh is None:
             return push_packed(table_state, rows, grads, self.access, lr), jnp.int32(0)
-        if self.push_mode == "bucketed":
-            from swiftsnails_tpu.parallel.transfer import (
-                push_collective_packed_bucketed,
-            )
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
 
-            return push_collective_packed_bucketed(
+        with self._tbl_scope(tbl):
+            if is_hybrid(table_state):
+                from swiftsnails_tpu.parallel.hybrid import (
+                    push_hybrid_packed,
+                    push_hybrid_packed_bucketed,
+                )
+
+                if self.push_mode == "bucketed":
+                    return push_hybrid_packed_bucketed(
+                        self.mesh, table_state, rows, grads, self.access, lr,
+                        slack=self.bucket_slack, comm_dtype=self.comm_dtype,
+                        seed=seed)
+                return push_hybrid_packed(
+                    self.mesh, table_state, rows, grads, self.access, lr,
+                    self._hybrid_cap(rows.shape[0]),
+                    comm_dtype=self.comm_dtype, seed=seed)
+            if self.push_mode == "bucketed":
+                from swiftsnails_tpu.parallel.transfer import (
+                    push_collective_packed_bucketed,
+                )
+
+                return push_collective_packed_bucketed(
+                    self.mesh, table_state, rows, grads, self.access, lr,
+                    slack=self.bucket_slack, comm_dtype=self.comm_dtype,
+                    seed=seed,
+                )
+            from swiftsnails_tpu.parallel.transfer import push_collective_packed
+
+            return push_collective_packed(
                 self.mesh, table_state, rows, grads, self.access, lr,
-                slack=self.bucket_slack, comm_dtype=self.comm_dtype,
-                seed=seed,
-            )
-        from swiftsnails_tpu.parallel.transfer import push_collective_packed
-
-        return push_collective_packed(
-            self.mesh, table_state, rows, grads, self.access, lr,
-            comm_dtype=self.comm_dtype, seed=seed,
-        ), jnp.int32(0)
+                comm_dtype=self.comm_dtype, seed=seed,
+            ), jnp.int32(0)
 
     # -- data --------------------------------------------------------------
 
@@ -565,6 +724,26 @@ class Word2VecTrainer(Trainer):
             pc -= 1
         return pc
 
+    def _dpull(self, table_state, rows, tbl=None):
+        """Dense-plane pull: pjit store gather, or the hybrid dense twin."""
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid, pull_hybrid
+
+        with self._tbl_scope(tbl):
+            if is_hybrid(table_state):
+                return pull_hybrid(self.mesh, table_state, rows,
+                                   comm_dtype=self.comm_dtype)
+            return pull(table_state, rows)
+
+    def _dpush(self, table_state, rows, grads, lr, seed=None, tbl=None):
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid, push_hybrid
+
+        with self._tbl_scope(tbl):
+            if is_hybrid(table_state):
+                return push_hybrid(self.mesh, table_state, rows, grads,
+                                   self.access, lr, comm_dtype=self.comm_dtype,
+                                   seed=seed)
+            return push(table_state, rows, grads, self.access, lr)
+
     def _substep_dense(self, state: W2VState, centers, contexts, rng, lr,
                        negs=None):
         """Reference-faithful substep: per-pair negatives, 2-D tables.
@@ -577,15 +756,18 @@ class Word2VecTrainer(Trainer):
         in_rows = self._step_rows(centers)
         out_rows = self._step_rows(self._id_cat(contexts, negs.reshape(-1)))
 
-        v = pull(state.in_table, in_rows)
-        u = pull(state.out_table, out_rows)
+        v = self._dpull(state.in_table, in_rows, tbl="in")
+        u = self._dpull(state.out_table, out_rows, tbl="out")
 
         def loss_of(v, u):
             return sgns_loss(v, u[:b], u[b:].reshape(b, k, -1))
 
         loss, (dv, du) = jax.value_and_grad(loss_of, argnums=(0, 1))(v, u)
-        in_table = push(state.in_table, in_rows, dv, self.access, lr)
-        out_table = push(state.out_table, out_rows, du, self.access, lr)
+        seed = self._comm_seed(rng)
+        in_table = self._dpush(state.in_table, in_rows, dv, lr, seed=seed,
+                               tbl="in")
+        out_table = self._dpush(state.out_table, out_rows, du, lr, seed=seed,
+                                tbl="out")
         return W2VState(in_table, out_table), loss, jnp.int32(0)
 
     def _substep_packed(self, state: W2VState, centers, contexts, rng, lr,
@@ -615,8 +797,8 @@ class Word2VecTrainer(Trainer):
         pool_rows = self._step_rows(pools.reshape(-1))
         out_rows = self._id_cat(pos_rows, pool_rows)
 
-        v = self._ppull(state.in_table, in_rows)
-        u = self._ppull(state.out_table, out_rows)
+        v = self._ppull(state.in_table, in_rows, tbl="in")
+        u = self._ppull(state.out_table, out_rows, tbl="out")
         u_pos = u[:b]
         pool = u[b:].reshape(nb, pn, *u.shape[1:])
 
@@ -636,8 +818,10 @@ class Word2VecTrainer(Trainer):
         )
         du = jnp.concatenate([du_pos, dpool.reshape(-1, *dpool.shape[2:])])
         seed = self._comm_seed(rng)
-        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr, seed=seed)
-        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr, seed=seed)
+        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr, seed=seed,
+                                   tbl="in")
+        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr,
+                                    seed=seed, tbl="out")
         return W2VState(in_table, out_table), loss, d1 + d2
 
     def _substep_fused(self, state: W2VState, centers, contexts, rng, lr):
@@ -796,23 +980,51 @@ class Word2VecTrainer(Trainer):
         pool_rows = self._rows(pools.reshape(-1))
         mask = (ctxs >= 0).astype(jnp.float32)  # [n, cw]
 
-        v = self._ppull(state.in_table, center_rows)  # [n, S, L]
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
+
+        v = self._ppull(state.in_table, center_rows, tbl="in")  # [n, S, L]
         out_pull_rows = self._id_cat(ctx_rows.reshape(-1), pool_rows)
         d_pull = jnp.int32(0)
         u_index = None
-        if self.dedup:
-            from swiftsnails_tpu.parallel.transfer import (
-                pull_collective_packed_dedup,
-            )
+        hybrid = is_hybrid(state.out_table)
+        if self.dedup or hybrid:
+            # hybrid rides the same unique-list plane (its tail pull IS a
+            # dedup pull at the coverage-sized cap); keep the (uniq, inv)
+            # index so the push half skips the duplicate sort
+            cap = self._out_u_cap(n, out_pull_rows.shape[0], hybrid)
+            with self._tbl_scope("out"):
+                if hybrid:
+                    from swiftsnails_tpu.parallel.hybrid import (
+                        pull_hybrid_packed,
+                    )
 
-            u_all, u_index, d_pull = pull_collective_packed_dedup(
-                self.mesh, state.out_table, out_pull_rows, self._mesh_u_cap(n),
-                comm_dtype=self.comm_dtype)
+                    u_all, u_index, d_pull = pull_hybrid_packed(
+                        self.mesh, state.out_table, out_pull_rows, cap,
+                        comm_dtype=self.comm_dtype)
+                else:
+                    from swiftsnails_tpu.parallel.transfer import (
+                        pull_collective_packed_dedup,
+                    )
+
+                    u_all, u_index, d_pull = pull_collective_packed_dedup(
+                        self.mesh, state.out_table, out_pull_rows, cap,
+                        comm_dtype=self.comm_dtype)
         else:
-            u_all = self._ppull(state.out_table, out_pull_rows)
+            u_all = self._ppull(state.out_table, out_pull_rows, tbl="out")
         seed = self._comm_seed(rng)
         return (center_rows, out_pull_rows, mask, v, u_all, u_index, d_pull,
                 seed)
+
+    def _out_u_cap(self, n: int, out_rows: int, hybrid: bool) -> int:
+        """Unique capacity for the grouped plane's out-table dedup pull:
+        the dedup lane's slot-scaled cap, the hybrid coverage cap, or the
+        min of both when they compose."""
+        caps = []
+        if self.dedup:
+            caps.append(self._mesh_u_cap(n))
+        if hybrid:
+            caps.append(self._hybrid_cap(out_rows))
+        return min(caps)
 
     def _push_grouped_mesh(self, state: W2VState, pulled, lr):
         """Push half: SGNS loss/grads on the pulled rows, merged push of both
@@ -848,22 +1060,37 @@ class Word2VecTrainer(Trainer):
         out_grads = self._mesh_safe_cat(
             [du.reshape((n * cw,) + du.shape[2:]),
              dq.reshape((nb * pn,) + dq.shape[2:])])
-        in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr,
-                                   seed=seed)
-        if self.dedup and self.push_mode != "bucketed":
-            from swiftsnails_tpu.parallel.transfer import (
-                push_collective_packed_dedup,
-            )
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
 
+        hybrid = is_hybrid(state.out_table)
+        in_table, d1 = self._ppush(state.in_table, center_rows, dv, lr,
+                                   seed=seed, tbl="in")
+        if (self.dedup or hybrid) and self.push_mode != "bucketed":
             # reuse the pull's unique index: skips the duplicate sort and
             # keeps the overflow metric single-counted (d2 is 0 here)
-            out_table, d2 = push_collective_packed_dedup(
-                self.mesh, state.out_table, out_pull_rows, out_grads,
-                self.access, lr, self._mesh_u_cap(n), index=u_index,
-                comm_dtype=self.comm_dtype, seed=seed)
+            cap = self._out_u_cap(n, out_pull_rows.shape[0], hybrid)
+            with self._tbl_scope("out"):
+                if hybrid:
+                    from swiftsnails_tpu.parallel.hybrid import (
+                        push_hybrid_packed,
+                    )
+
+                    out_table, d2 = push_hybrid_packed(
+                        self.mesh, state.out_table, out_pull_rows, out_grads,
+                        self.access, lr, cap, index=u_index,
+                        comm_dtype=self.comm_dtype, seed=seed)
+                else:
+                    from swiftsnails_tpu.parallel.transfer import (
+                        push_collective_packed_dedup,
+                    )
+
+                    out_table, d2 = push_collective_packed_dedup(
+                        self.mesh, state.out_table, out_pull_rows, out_grads,
+                        self.access, lr, cap, index=u_index,
+                        comm_dtype=self.comm_dtype, seed=seed)
         else:
             out_table, d2 = self._ppush(state.out_table, out_pull_rows,
-                                        out_grads, lr, seed=seed)
+                                        out_grads, lr, seed=seed, tbl="out")
         return W2VState(in_table, out_table), loss, d_pull + d1 + d2
 
     def _overlap_macro(self, state: W2VState, c, x, keys, lr):
@@ -906,8 +1133,8 @@ class Word2VecTrainer(Trainer):
         in_rows = self._step_rows(centers)
         out_rows = self._step_rows(self._id_cat(contexts, negs.reshape(-1)))
 
-        v = self._ppull(state.in_table, in_rows)
-        u = self._ppull(state.out_table, out_rows)
+        v = self._ppull(state.in_table, in_rows, tbl="in")
+        u = self._ppull(state.out_table, out_rows, tbl="out")
         u_pos = u[:b]
         u_neg = u[b:].reshape(b, k, *u.shape[1:])
 
@@ -923,8 +1150,10 @@ class Word2VecTrainer(Trainer):
         )
         du = jnp.concatenate([du_pos, du_neg.reshape(-1, *du_neg.shape[2:])])
         seed = self._comm_seed(rng)
-        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr, seed=seed)
-        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr, seed=seed)
+        in_table, d1 = self._ppush(state.in_table, in_rows, dv, lr, seed=seed,
+                                   tbl="in")
+        out_table, d2 = self._ppush(state.out_table, out_rows, du, lr,
+                                    seed=seed, tbl="out")
         return W2VState(in_table, out_table), loss, d1 + d2
 
     def train_step(self, state: W2VState, batch, rng):
@@ -969,6 +1198,9 @@ class Word2VecTrainer(Trainer):
                 m["push_dropped"] = dropped
             elif self.dedup and self.mesh is not None:
                 m["dedup_dropped"] = dropped
+            elif self.placement_cut and self.mesh is not None:
+                # hybrid tail unique-capacity overflow (coverage-sized cap)
+                m["hybrid_dropped"] = dropped
             return m
 
         # table_tier: host — negatives were sampled host-side by tier_plan
@@ -1109,8 +1341,7 @@ class Word2VecTrainer(Trainer):
     def tier_warm_rows(self):
         """Hottest-first row ids for the cache prewarm (vocab frequency
         order; both tables share the unigram distribution)."""
-        order = np.argsort(
-            self.vocab.frequency_ranks(), kind="stable").astype(np.int64)
+        order = self.vocab.hottest_rows().astype(np.int64)
         rows = np.asarray(self._plan_rows(order))
         return {"in_table": rows, "out_table": rows}
 

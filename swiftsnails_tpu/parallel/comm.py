@@ -182,6 +182,32 @@ def psum_quantized(vals: jax.Array, axis_name: str, comm_dtype: str) -> jax.Arra
     return dequantize_int8(q_sum, s_sum).astype(vals.dtype)
 
 
+def reduce_sum_quantized(
+    x: jax.Array,
+    axis_name: str,
+    comm_dtype: str,
+    axis_size: int,
+    stochastic: bool = False,
+    seed=None,
+) -> jax.Array:
+    """Dense gradient all-reduce with a compressed payload (NOT
+    owner-exclusive: every shard contributes to every position, so the
+    psum_quantized trick of summing (q, scale) pairs would be wrong).
+
+    f32 is a plain ``lax.psum``. bf16/int8 quantize per shard, move the
+    compressed payload with a tiled all_gather, and accumulate in f32 at
+    the receiver — the wire stays narrow, the sum stays full precision.
+    ``axis_size`` must be the static size of ``axis_name`` (it shapes the
+    de-tiling reshape). Used by the hybrid head push
+    (parallel/hybrid.py), where all data shards hold gradients for the
+    same replicated rows."""
+    if comm_dtype == "float32":
+        return lax.psum(x, axis_name)
+    g = all_gather_quantized(x, axis_name, comm_dtype,
+                             stochastic=stochastic, seed=seed)
+    return g.reshape((axis_size,) + x.shape).astype(jnp.float32).sum(axis=0)
+
+
 def all_gather_quantized(
     x: jax.Array,
     axis_name: str,

@@ -1,0 +1,189 @@
+"""Placement policy: pick the head/tail cut and manage the hybrid split.
+
+The auto-partitioner (``placement: auto``) follows Parallax: the decision
+input is the vocabulary's frequency CDF (``data/vocab.py`` cumulative
+coverage — vocab ids are frequency ranks, so a prefix cut IS the zipf
+head) plus a wire-cost model calibrated against the ``ssn_*`` comm-audit
+measured bytes of the uniform layout. For each aligned candidate cut ``k``
+it predicts the per-step exchange bytes of a hybrid split at ``k`` and
+takes the argmin — ``k = 0`` (stay uniform) always competes, so flat
+distributions resolve to uniform automatically.
+
+Cost model (per train substep, per data shard; see docs/SCALING.md):
+
+* uniform — pull assembles + push gathers roughly the full local batch of
+  row payloads: ``U ≈ 2 · local_slots · row_bytes``. When a measured
+  uniform byte count is available (``placement_calib_bytes``, or the bench
+  calibration pass) the model rescales so ``U`` matches it.
+* hybrid(k) — the tail rides the dedup twins at a static unique capacity
+  ``tail_cap(k) = align8(slack · (1 − cov(k)) · local_slots)``, so tail
+  bytes shrink by ``tail_cap / local_slots``; the head adds one dense
+  reduce of ``k`` rows (psum for f32; quantized all_gather, so ×data
+  received copies, for bf16/int8).
+
+``PlacementManager`` mirrors the TierManager surface (adopt /
+master_state / summary) over the same ``tier_tables`` / ``tier_with_tables``
+trainer hooks, so TrainLoop, checkpointing, and resume integrate the same
+way the tiered store does.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+PLACEMENT_MODES = ("uniform", "hybrid", "auto")
+
+
+def resolve_placement(name: Optional[str]) -> str:
+    name = (name or "uniform").lower()
+    if name not in PLACEMENT_MODES:
+        raise ValueError(
+            f"unknown placement {name!r}; expected one of {PLACEMENT_MODES}")
+    return name
+
+
+def align_down(k: int, align: int) -> int:
+    return (int(k) // max(align, 1)) * max(align, 1)
+
+
+def cap8(n: float, lo: int = 8) -> int:
+    """Round a slot-count estimate up to a multiple of 8 (lane-friendly)."""
+    return max(-(-int(np.ceil(n)) // 8) * 8, lo)
+
+
+def tail_cap(local_slots: int, coverage: float, slack: float = 2.0) -> int:
+    """Static unique capacity for the hybrid tail's dedup twins."""
+    want = slack * max(1.0 - float(coverage), 0.0) * max(local_slots, 1)
+    return min(cap8(want), cap8(local_slots, lo=8))
+
+
+def row_wire_bytes(row_elems: int, comm_dtype: str) -> float:
+    """Approximate wire bytes for one row payload at a comm dtype."""
+    if comm_dtype == "bfloat16":
+        return 2.0 * row_elems
+    if comm_dtype == "int8":
+        return 1.0 * row_elems + 4.0  # per-row f32 scale rides alongside
+    return 4.0 * row_elems
+
+
+def candidate_cuts(capacity: int, align: int, vocab_rows: int,
+                   max_head_frac: float = 0.5):
+    """Aligned candidate cuts: 0 (uniform) plus a pow2 ladder of ``align``."""
+    limit = int(capacity * max_head_frac)
+    cuts = [0]
+    k = max(align, 1)
+    while k <= limit:
+        cuts.append(k)
+        k *= 2
+    tip = align_down(min(vocab_rows, limit), align)
+    if tip and tip not in cuts:
+        cuts.append(tip)
+    return sorted(set(cuts))
+
+
+def choose_cut(
+    counts: np.ndarray,
+    capacity: int,
+    *,
+    align: int,
+    local_slots: int,
+    row_elems: int,
+    data: int = 1,
+    slack: float = 2.0,
+    comm_dtype: str = "float32",
+    measured_uniform_bytes: Optional[float] = None,
+    max_head_frac: float = 0.5,
+) -> Dict:
+    """Pick the head/tail cut from the frequency CDF + calibrated cost model.
+
+    ``counts`` must be frequency-rank ordered (descending), as
+    ``Vocab.from_counter`` builds them — row id == rank, so the coverage of
+    a prefix cut is the CDF at that rank. Returns the decision dict that
+    lands in the bench JSON / run record / ledger."""
+    counts = np.asarray(counts, dtype=np.float64)
+    total = float(counts.sum()) or 1.0
+    cdf = np.concatenate([[0.0], np.cumsum(counts) / total])
+
+    def cov(k: int) -> float:
+        return float(cdf[min(k, len(counts))])
+
+    rb = row_wire_bytes(row_elems, comm_dtype)
+    uniform_pred = 2.0 * max(local_slots, 1) * rb
+    scale = 1.0
+    if measured_uniform_bytes:
+        scale = float(measured_uniform_bytes) / uniform_pred
+    head_copies = 1 if comm_dtype == "float32" else max(data, 1)
+
+    best_k, best_cost = 0, uniform_pred * scale
+    for k in candidate_cuts(capacity, align, len(counts), max_head_frac):
+        if k == 0:
+            continue
+        t_cap = tail_cap(local_slots, cov(k), slack)
+        tail_bytes = uniform_pred * scale * (t_cap / max(local_slots, 1))
+        head_bytes = k * rb * head_copies
+        cost = tail_bytes + head_bytes
+        if cost < best_cost:
+            best_k, best_cost = k, cost
+    return {
+        "cut": int(best_k),
+        "coverage": cov(best_k),
+        "predicted_exchange_bytes": float(best_cost),
+        "predicted_uniform_bytes": float(uniform_pred * scale),
+        "measured_uniform_bytes": (
+            float(measured_uniform_bytes) if measured_uniform_bytes else None),
+    }
+
+
+class PlacementManager:
+    """Hybrid split lifecycle over the trainer's tier-table hooks.
+
+    ``adopt`` splits a uniform-layout state into head/tail planes after
+    init/restore; ``master_state`` merges back to the uniform layout (the
+    only layout checkpoints, serving, and the tiered store ever see). Both
+    are eager value-preserving reshapes — see parallel/hybrid.py."""
+
+    def __init__(self, trainer, mesh=None):
+        self.trainer = trainer
+        self.mesh = mesh if mesh is not None else getattr(trainer, "mesh", None)
+        self.spec = trainer.placement_spec() or {}
+
+    @property
+    def active(self) -> bool:
+        return any(sp.get("cut", 0) > 0 for sp in self.spec.values())
+
+    def adopt(self, state):
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid, split_table
+
+        if not self.active:
+            return state
+        tables = self.trainer.tier_tables(state)
+        new = {}
+        for name, sp in self.spec.items():
+            cut = sp.get("cut", 0)
+            ts = tables.get(name)
+            if ts is None or cut <= 0 or is_hybrid(ts):
+                continue
+            new[name] = split_table(ts, cut, self.mesh, sp.get("group", 1))
+        if new:
+            log.info("placement: adopted hybrid split for %s",
+                     {k: self.spec[k]["cut"] for k in new})
+            state = self.trainer.tier_with_tables(state, new)
+        return state
+
+    def master_state(self, state):
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid, merge_table
+
+        tables = self.trainer.tier_tables(state)
+        new = {name: merge_table(ts, self.mesh)
+               for name, ts in tables.items() if is_hybrid(ts)}
+        if new:
+            state = self.trainer.tier_with_tables(state, new)
+        return state
+
+    def summary(self) -> Dict:
+        return dict(getattr(self.trainer, "placement_decision", None) or {})

@@ -77,14 +77,21 @@ def _shape_bytes(shape: str) -> int:
 def collective_stats(hlo_text: str) -> Dict:
     """Per-collective counts/bytes (sync and async forms) from HLO text.
 
-    Returns ``{"ops": {op: {"count", "bytes"}}, "total_bytes", "by_scope"}``
-    where ``op`` is the base HLO name (``-start`` folded in) and
-    ``by_scope`` groups bytes under any ``ssn_*`` label found in the
-    instruction's ``op_name`` metadata (see the ``jax.named_scope`` labels
-    in ``parallel/transfer.py`` / ``parallel/store.py``).
+    Returns ``{"ops": {op: {"count", "bytes"}}, "total_bytes", "by_scope",
+    "by_table"}`` where ``op`` is the base HLO name (``-start`` folded in)
+    and ``by_scope`` groups bytes under the first non-table ``ssn_*`` label
+    found in the instruction's ``op_name`` metadata (see the
+    ``jax.named_scope`` labels in ``parallel/transfer.py`` /
+    ``parallel/store.py``). ``ssn_tbl_*`` labels are the per-table
+    attribution scopes the trainers wrap around whole pull/push call sites
+    (outer scopes, so they co-occur with the collective's own label on one
+    ``op_name``); they are routed to ``by_table`` keyed by the table name so
+    the placement/bench stack can split exchange bytes per table without
+    disturbing the existing per-collective scope keys.
     """
     ops: Dict[str, Dict[str, int]] = {}
     by_scope: Dict[str, int] = {}
+    by_table: Dict[str, int] = {}
     total = 0
     for line in hlo_text.splitlines():
         m = _DEFINING_RE.search(line)
@@ -98,11 +105,18 @@ def collective_stats(hlo_text: str) -> Dict:
         total += nbytes
         name_m = _OP_NAME_RE.search(line)
         if name_m:
-            scope_m = _SCOPE_RE.search(name_m.group(1))
-            if scope_m:
+            scoped = False
+            for scope_m in _SCOPE_RE.finditer(name_m.group(1)):
                 scope = scope_m.group(1)
-                by_scope[scope] = by_scope.get(scope, 0) + nbytes
-    return {"ops": ops, "total_bytes": total, "by_scope": by_scope}
+                if scope.startswith("ssn_tbl_"):
+                    tbl = scope[len("ssn_tbl_"):]
+                    by_table[tbl] = by_table.get(tbl, 0) + nbytes
+                elif not scoped:
+                    # first non-table label = the collective's own scope
+                    by_scope[scope] = by_scope.get(scope, 0) + nbytes
+                    scoped = True
+    return {"ops": ops, "total_bytes": total, "by_scope": by_scope,
+            "by_table": by_table}
 
 
 def collective_bytes(hlo_text: str, op_pattern: Optional[str] = None) -> int:

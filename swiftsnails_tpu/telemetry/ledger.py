@@ -437,6 +437,57 @@ def render_report(ledger: Ledger) -> str:
                        if "flush_queue_depth" in bd else "")
                 )
 
+    # hybrid placement: run records carry a `placement` decision when the
+    # mode was hybrid/auto (including auto runs that resolved back to
+    # uniform, with the reason); bench records carry the skewed scaling
+    # leg's uniform-vs-hybrid exchange comparison
+    placement_rows = []
+    for r in runs:
+        pl = r.get("placement")
+        if isinstance(pl, dict):
+            placement_rows.append((r.get("ts", "?"), "run  ", pl, None))
+    for r in ledger.records("bench"):
+        p = r.get("payload") if isinstance(r.get("payload"), dict) else {}
+        scal = (p or {}).get("scaling")
+        sk = scal.get("skewed") if isinstance(scal, dict) else None
+        if isinstance(sk, dict):
+            placement_rows.append(
+                (r.get("ts", "?"), "bench", sk.get("decision") or {}, sk))
+    if placement_rows:
+        lines.append("")
+        lines.append("hybrid placement (newest last):")
+        for ts, kind, pl, sk in placement_rows[-5:]:
+            cov = pl.get("coverage")
+            lines.append(
+                f"  {ts}  {kind}  mode={pl.get('mode', 'hybrid')}  "
+                f"cut={pl.get('cut')}  "
+                f"replicated_rows={pl.get('replicated_rows', pl.get('cut'))}  "
+                f"coverage="
+                + (f"{cov:.3f}" if isinstance(cov, (int, float)) else "n/a")
+            )
+            if pl.get("reason"):
+                lines.append(f"    reason: {pl['reason']}")
+            pred = pl.get("predicted_exchange_bytes")
+            meas = pl.get("measured_exchange_bytes")
+            if pred is not None or meas is not None:
+                lines.append(
+                    f"    exchange bytes: predicted={_fmt_num(pred or 0)}B  "
+                    f"uniform={_fmt_num(pl.get('predicted_uniform_bytes', 0))}B"
+                    f"  measured={_fmt_num(meas or 0)}B"
+                )
+            if sk is not None and isinstance(sk.get("per_dtype"), dict):
+                for dt, row in sorted(sk["per_dtype"].items()):
+                    red = row.get("exchange_reduction")
+                    lines.append(
+                        f"    skewed[{dt}]: "
+                        f"uniform={_fmt_num(row.get('uniform_exchange_bytes', 0))}B  "
+                        f"hybrid={_fmt_num(row.get('hybrid_exchange_bytes', 0))}B  "
+                        "reduction="
+                        + (f"{red:.2f}x" if isinstance(red, (int, float))
+                           else "n/a")
+                        + f"  loss_delta={row.get('loss_delta')}"
+                    )
+
     outages = ledger.records("outage")
     if outages:
         lines.append("")
@@ -649,7 +700,10 @@ def check_regression(
         k_rc, k_msg = _check_chaos_cluster_regression(ledger)
         if k_msg:
             msg = f"{msg}\n{k_msg}"
-        return max(2, c_rc, v_rc, t_rc, a_rc, k_rc), msg
+        p_rc, p_msg = _check_placement_regression(ledger)
+        if p_msg:
+            msg = f"{msg}\n{p_msg}"
+        return max(2, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -674,7 +728,10 @@ def check_regression(
             k_rc, k_msg = _check_chaos_cluster_regression(ledger)
             if k_msg:
                 msg = f"{msg}\n{k_msg}"
-            return max(0, c_rc, v_rc, t_rc, a_rc, k_rc), msg
+            p_rc, p_msg = _check_placement_regression(ledger)
+            if p_msg:
+                msg = f"{msg}\n{p_msg}"
+            return max(0, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -706,7 +763,10 @@ def check_regression(
     k_rc, k_msg = _check_chaos_cluster_regression(ledger)
     if k_msg:
         msg = f"{msg}\n{k_msg}"
-    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc, k_rc), msg
+    p_rc, p_msg = _check_placement_regression(ledger)
+    if p_msg:
+        msg = f"{msg}\n{p_msg}"
+    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -757,6 +817,53 @@ def _check_scaling_regression(
     return 0, (
         f"scaling ok: aggregate {newest:,.1f} vs baseline {baseline:,.1f} "
         f"words/s ({(newest / baseline - 1) * 100:+.1f}%)"
+    )
+
+
+# the skewed scaling leg must keep cutting audited exchange bytes by at
+# least this factor (uniform / hybrid) at every comm dtype it ran
+_SKEWED_EXCHANGE_FLOOR = 2.0
+
+
+def _check_placement_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the skewed lane's exchange-byte win alongside the perf headline.
+
+    The numbers are compiled-HLO collective bytes (telemetry/audit.py) —
+    static shapes, platform-independent — so CPU lane runs count, same as
+    the chaos gates. A ledger with no skewed block (pre-lane history) gates
+    nothing."""
+    with_skew = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("scaling"), dict)
+        and isinstance(r["payload"]["scaling"].get("skewed"), dict)
+    ]
+    if not with_skew:
+        return 0, None
+    sk = with_skew[-1]["payload"]["scaling"]["skewed"]
+    per = sk.get("per_dtype")
+    if not isinstance(per, dict) or not per:
+        return 1, ("placement REGRESSION: skewed leg ran but recorded no "
+                   "per-dtype exchange rows")
+    bad = []
+    worst = None
+    for dt, row in sorted(per.items()):
+        red = row.get("exchange_reduction")
+        if not isinstance(red, (int, float)):
+            bad.append(f"{dt}=n/a")
+            continue
+        worst = red if worst is None else min(worst, red)
+        if red < _SKEWED_EXCHANGE_FLOOR:
+            bad.append(f"{dt}={red:.2f}x")
+    if bad:
+        return 1, (
+            "placement REGRESSION: skewed-lane exchange reduction below the "
+            f"{_SKEWED_EXCHANGE_FLOOR:.1f}x floor: " + ", ".join(bad)
+        )
+    return 0, (
+        f"placement ok: skewed-lane exchange reduction >= "
+        f"{_SKEWED_EXCHANGE_FLOOR:.1f}x at every comm dtype "
+        f"(worst {worst:.2f}x)"
     )
 
 
