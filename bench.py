@@ -809,7 +809,7 @@ def kernel_copies_per_pair(gbatches, counts, hot_n=0, u_cap=0, pc=256,
 # `ledger-report --check-regression` gates on its aggregate words/sec
 # alongside the headline.
 SCALING_MIN_BUDGET_S = int(os.environ.get("SSN_SCALING_MIN_BUDGET_S", "240"))
-SCALING_COMM_DTYPES = ("float32", "bfloat16", "int8")
+SCALING_COMM_DTYPES = ("float32", "bfloat16", "int8", "int4")
 SCALING_BATCH_PER_SHARD = 512 if _SMALL else 8192
 SCALING_STEPS_PER_CALL = 2 if _SMALL else 8
 SCALING_MEASURE_STEPS = 4 if _SMALL else 16

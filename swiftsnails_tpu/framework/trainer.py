@@ -892,6 +892,11 @@ class TrainLoop:
                     "goodput": report,
                     "final_metrics": final_metrics or None,
                 }
+                wire = getattr(self.trainer, "comm_dtype", None)
+                if wire:
+                    # the active wire format, so `ledger-report` run lines
+                    # show what a quantized run actually moved
+                    record["comm_dtype"] = wire
                 if self.guardrail is not None:
                     record["guardrail"] = self.guardrail.summary()
                 if self.chaos is not None:

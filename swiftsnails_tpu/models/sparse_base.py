@@ -119,11 +119,14 @@ class SparseCTRTrainer(Trainer):
         # is tiered — the dense/opt pytrees are tiny and stay resident.
         self.tiered = cfg.get_str("table_tier", "device") == "host"
         # comm_dtype: ICI payload compression for the mesh collectives
-        # (f32 default = bit-identical; see parallel/comm.py, docs/SCALING.md)
-        from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
+        # (f32 default = bit-identical; see parallel/comm.py, docs/SCALING.md;
+        # comm_int4_block overrides the int4 scale-block width)
+        from swiftsnails_tpu.parallel.comm import (apply_int4_block,
+                                                   resolve_comm_dtype)
 
-        self.comm_dtype = resolve_comm_dtype(
-            cfg.get_str("comm_dtype", "float32"))
+        self.comm_dtype = apply_int4_block(
+            resolve_comm_dtype(cfg.get_str("comm_dtype", "float32")),
+            cfg.get_int("comm_int4_block", 0))
         # placement: uniform|hybrid|auto — head/tail hybrid placement of the
         # hashed table (parallel/hybrid.py). CTR row ids are hash outputs, so
         # `auto` (which needs frequency-rank prefix structure) resolves to

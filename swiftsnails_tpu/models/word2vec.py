@@ -205,13 +205,18 @@ class Word2VecTrainer(Trainer):
         self.bucket_slack = cfg.get_float("bucket_slack", 2.0)
         # comm_dtype: ICI payload compression for every mesh collective —
         # f32 (default, bit-identical HLO), bf16 (~2x fewer payload bytes),
-        # int8 (per-row scale, stochastic-rounded gradients, ~3.5x). The
-        # master tables and all shard-local math stay full precision; only
-        # the all_gather/psum wire format narrows (parallel/comm.py,
+        # int8 (per-row scale, stochastic-rounded gradients, ~3.5x), int4
+        # (block-wise nibble codes + bf16 block scales, ~7x;
+        # comm_int4_block overrides the 32-lane default). The master tables
+        # and all shard-local math stay full precision; only the
+        # all_gather/psum wire format narrows (parallel/comm.py,
         # docs/SCALING.md). Meaningless without a mesh (no collectives).
-        from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
+        from swiftsnails_tpu.parallel.comm import (apply_int4_block,
+                                                   resolve_comm_dtype)
 
-        self.comm_dtype = resolve_comm_dtype(cfg.get_str("comm_dtype", "float32"))
+        self.comm_dtype = apply_int4_block(
+            resolve_comm_dtype(cfg.get_str("comm_dtype", "float32")),
+            cfg.get_int("comm_int4_block", 0))
         # overlap: 1 -> software-pipelined macro-step on the grouped mesh
         # plane: substep i's push collectives issue together with substep
         # i+1's pull (which reads the PRE-push tables — stale-by-one reads,
@@ -526,12 +531,12 @@ class Word2VecTrainer(Trainer):
                 self.mesh, table_state, rows, comm_dtype=self.comm_dtype)
 
     def _comm_seed(self, rng):
-        """uint32 dither seed for int8 stochastic rounding (None unless the
-        int8 wire format is active — keeps every other path op-free)."""
-        if self.comm_dtype != "int8" or self.mesh is None:
-            return None
-        from swiftsnails_tpu.parallel.comm import seed_from_key
+        """uint32 dither seed for int8/int4 stochastic rounding (None unless
+        an integer wire format is active — keeps every other path op-free)."""
+        from swiftsnails_tpu.parallel.comm import seed_from_key, stochastic_wire
 
+        if not stochastic_wire(self.comm_dtype) or self.mesh is None:
+            return None
         return seed_from_key(rng)
 
     def _ppush(self, table_state, rows, grads, lr, seed=None, tbl=None):

@@ -64,10 +64,17 @@ def tail_cap(local_slots: int, coverage: float, slack: float = 2.0) -> int:
 
 def row_wire_bytes(row_elems: int, comm_dtype: str) -> float:
     """Approximate wire bytes for one row payload at a comm dtype."""
+    from swiftsnails_tpu.parallel.comm import int4_block, is_int4
+
     if comm_dtype == "bfloat16":
         return 2.0 * row_elems
     if comm_dtype == "int8":
         return 1.0 * row_elems + 4.0  # per-row f32 scale rides alongside
+    if is_int4(comm_dtype):
+        # packed nibbles (padded to a whole block) + one bf16 scale per block
+        blk = int4_block(comm_dtype)
+        nblocks = max(-(-int(row_elems) // blk), 1)
+        return 0.5 * nblocks * blk + 2.0 * nblocks
     return 4.0 * row_elems
 
 

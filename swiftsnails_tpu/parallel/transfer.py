@@ -40,6 +40,7 @@ from swiftsnails_tpu.parallel.comm import (
     all_gather_quantized,
     psum_quantized,
     resolve_comm_dtype,
+    stochastic_wire,
 )
 from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from swiftsnails_tpu.parallel.store import TableState, apply_rows, merge_duplicate_rows
@@ -56,8 +57,8 @@ from swiftsnails_tpu.parallel.store import TableState, apply_rows, merge_duplica
 
 
 def _seed_operand(comm_dtype: str, seed):
-    """(extra_args, extra_specs) for the optional int8 dither seed."""
-    if comm_dtype != "int8":
+    """(extra_args, extra_specs) for the optional int8/int4 dither seed."""
+    if not stochastic_wire(comm_dtype):
         return (), ()
     s = jnp.uint32(0) if seed is None else jnp.asarray(seed).astype(jnp.uint32)
     return (s,), (P(),)

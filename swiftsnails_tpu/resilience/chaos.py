@@ -318,12 +318,17 @@ class ChaosPlan:
         drain = getattr(tier, "_drain", None)
         if drain is not None:
             drain()
-        flat = tier.tables[name].master.table.view(np.uint8).reshape(-1)
+        # any master plane is fair game — including a quantized master's
+        # scale sidebands ("<plane>/scale"), where one flipped bit corrupts
+        # every element of its unit on dequant
+        planes = list(tier.tables[name].master._planes())
+        plane, arr = planes[int(self.rng.integers(0, len(planes)))]
+        flat = arr.view(np.uint8).reshape(-1)  # aliases the live plane
         off = int(self.rng.integers(0, flat.size))
         bit = int(self.rng.integers(0, 8))
         flat[off] ^= np.uint8(1 << bit)
         self._log("tier_bitflip", step,
-                  {"table": name, "plane": "table", "byte": off, "bit": bit})
+                  {"table": name, "plane": plane, "byte": off, "bit": bit})
         return name
 
     # -- serving-surface faults (consulted by the Servant's fault hook / the

@@ -32,6 +32,7 @@ DRILLS = (
     "ckpt_walkback",
     "preempt_resume",
     "tier_bitflip",
+    "tier_bitflip_int8",
 )
 
 
@@ -262,13 +263,21 @@ def drill_preempt_resume(workdir: Optional[str] = None, steps: int = 24,
 
 
 def drill_tier_bitflip(workdir: Optional[str] = None, steps: int = 12,
-                       flip_at: int = 6, **_ignored) -> Dict:
+                       flip_at: int = 6, master_dtype: str = "float32",
+                       **_ignored) -> Dict:
     """Silent host-RAM corruption of a tiered master plane: a seeded bit is
     XOR'd directly into a :class:`HostMaster` plane (bypassing ``scatter``,
     so only the integrity digests can see it). The per-step verify sweep
     must detect the corrupt plane, rebuild it from the newest verified
     checkpoint with the resident cache re-asserted on top, and the run must
-    finish with eval loss at parity with an unfaulted tiered control."""
+    finish with eval loss at parity with an unfaulted tiered control.
+
+    ``master_dtype: int8`` runs the same drill over quantized host masters
+    (code planes + scale sidebands); on top of the in-run flip, the result
+    carries a direct detection probe that flips one code byte AND one scale
+    byte on a throwaway quantized master and checks ``verify()`` names both
+    planes — the in-run rng picks only one plane, the probe pins coverage of
+    both kinds deterministically."""
     from swiftsnails_tpu.telemetry.ledger import Ledger
 
     workdir = _workdir(workdir)
@@ -277,6 +286,7 @@ def drill_tier_bitflip(workdir: Optional[str] = None, steps: int = 12,
         "tier_verify_period": 1,
         "steps_per_call": 1,
         "param_backup_period": 2,
+        "tier_master_dtype": master_dtype,
     }
 
     # unfaulted tiered control (same step semantics, no chaos)
@@ -304,11 +314,17 @@ def drill_tier_bitflip(workdir: Optional[str] = None, steps: int = 12,
         if r.get("source") == "tier":
             heal = r
     detected = heal is not None and heal.get("rebuilt_from_step") is not None
-    return {
+    probe_ok = True
+    probe: Optional[Dict] = None
+    if master_dtype != "float32":
+        probe = _quantized_plane_probe(master_dtype)
+        probe_ok = probe["code_detected"] and probe["scale_detected"]
+    out = {
         "recovered": bool(
             steps_done == steps
             and len(flips) == 1
             and detected
+            and probe_ok
             and tables_finite(state)
             and parity <= LOSS_PARITY_BAR
         ),
@@ -317,11 +333,41 @@ def drill_tier_bitflip(workdir: Optional[str] = None, steps: int = 12,
         "detected_planes": (heal or {}).get("planes"),
         "rebuilt_from_step": (heal or {}).get("rebuilt_from_step"),
         "rebuilt_tables": (heal or {}).get("tables"),
+        "master_dtype": master_dtype,
         "loss_control": round(loss_control, 6),
         "loss_faulted": round(loss_faulted, 6),
         "loss_parity": round(parity, 6),
         "parity_bar": LOSS_PARITY_BAR,
     }
+    if probe is not None:
+        out["plane_probe"] = probe
+    return out
+
+
+def _quantized_plane_probe(master_dtype: str) -> Dict:
+    """Deterministic digest-coverage probe for quantized masters: flip one
+    byte in the code plane and one in the scale sideband of a throwaway
+    int8 :class:`HostMaster`; both flips must surface in ``verify()``."""
+    from swiftsnails_tpu.parallel.store import TableState
+    from swiftsnails_tpu.tiered.store import HostMaster
+
+    rng = np.random.default_rng(3)
+    state = TableState(
+        table=rng.normal(size=(32, 8)).astype(np.float32), slots={})
+    m = HostMaster(state, "dense", master_dtype=master_dtype)
+    m.table.view(np.uint8).reshape(-1)[5] ^= np.uint8(1 << 3)
+    code_detected = "table" in m.verify()
+    m2 = HostMaster(state, "dense", master_dtype=master_dtype)
+    m2.scales["table"].view(np.uint8)[9] ^= np.uint8(1 << 2)
+    scale_detected = "table/scale" in m2.verify()
+    return {"code_detected": bool(code_detected),
+            "scale_detected": bool(scale_detected)}
+
+
+def drill_tier_bitflip_int8(workdir: Optional[str] = None, **kw) -> Dict:
+    """The tier bitflip drill over int8 (quantized) host masters."""
+    kw.pop("master_dtype", None)
+    return drill_tier_bitflip(workdir, master_dtype="int8", **kw)
 
 
 _DRILL_FNS: Dict[str, Callable[..., Dict]] = {
@@ -332,6 +378,7 @@ _DRILL_FNS: Dict[str, Callable[..., Dict]] = {
     "ckpt_walkback": drill_ckpt_walkback,
     "preempt_resume": drill_preempt_resume,
     "tier_bitflip": drill_tier_bitflip,
+    "tier_bitflip_int8": drill_tier_bitflip_int8,
 }
 
 FAST_DRILLS = ("nan_burst", "io_error", "ckpt_walkback")

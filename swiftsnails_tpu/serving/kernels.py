@@ -29,7 +29,15 @@ from typing import Any, Callable, Tuple
 import jax
 import jax.numpy as jnp
 
-from swiftsnails_tpu.parallel.comm import resolve_comm_dtype
+from swiftsnails_tpu.parallel.comm import (
+    dequantize_int4,
+    dequantize_int8,
+    int4_block,
+    is_int4,
+    quantize_int4,
+    quantize_int8,
+    resolve_comm_dtype,
+)
 from swiftsnails_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS  # noqa: F401
 from swiftsnails_tpu.parallel.store import TableState
 
@@ -38,10 +46,20 @@ def _wire_cast(vals: jax.Array, comm_dtype: str) -> jax.Array:
     """Single-device twin of the collective wire: the same precision loss
     the psum-over-model applies, so a 1-chip servant and a mesh servant
     answer identically for a given ``comm_dtype``. f32 is a no-op
-    (bit-identical pulls)."""
+    (bit-identical pulls). int8/int4 round deterministically — the pull
+    path never dithers (psum_quantized quantizes without a seed), so the
+    round trip here matches the owner-exclusive psum exactly."""
     if comm_dtype == "bfloat16":
         return vals.astype(jnp.bfloat16).astype(vals.dtype)
-    return vals  # float32 exact; int8 is a gradient-push wire, not a pull one
+    if comm_dtype == "int8":
+        q, scale = quantize_int8(vals)
+        return dequantize_int8(q, scale).astype(vals.dtype)
+    if is_int4(comm_dtype):
+        blk = int4_block(comm_dtype)
+        packed, scales = quantize_int4(vals, block=blk)
+        return dequantize_int4(packed, scales, vals.shape,
+                               block=blk).astype(vals.dtype)
+    return vals  # float32: exact
 
 
 def pull_rows(

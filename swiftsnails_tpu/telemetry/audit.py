@@ -38,13 +38,18 @@ COLLECTIVE_OPS = (
     "collective-permute",
 )
 
-_DTYPE_BYTES = {
-    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
-    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
+# element widths in BITS: sub-byte dtypes (s4/u4, the native int4 planes)
+# really cost half a byte per element on the wire, and counting them as u8
+# elements would understate a quantized wire's measured reduction by 2x
+_DTYPE_BITS = {
+    "s4": 4, "u4": 4,
+    "pred": 8, "s8": 8, "u8": 8,
+    "f8e4m3fn": 8, "f8e5m2": 8, "f8e4m3b11fnuz": 8, "f8e4m3fnuz": 8,
+    "f8e5m2fnuz": 8,
+    "s16": 16, "u16": 16, "f16": 16, "bf16": 16,
+    "s32": 32, "u32": 32, "f32": 32,
+    "s64": 64, "u64": 64, "f64": 64, "c64": 64,
+    "c128": 128,
 }
 
 # defining instruction: "<name> = <shape> <op>[-start](", where <shape> is a
@@ -59,11 +64,12 @@ _SCOPE_RE = re.compile(r"(ssn_[\w\-.]+)")
 
 
 def _atom_bytes(dtype: str, dims: str) -> int:
-    size = _DTYPE_BYTES.get(dtype)
-    if size is None:  # token/opaque/tuple-in-tuple: carries no payload here
+    bits = _DTYPE_BITS.get(dtype)
+    if bits is None:  # token/opaque/tuple-in-tuple: carries no payload here
         return 0
     shape = [int(d) for d in dims.split(",") if d]
-    return size * (int(np.prod(shape)) if shape else 1)
+    n = int(np.prod(shape)) if shape else 1
+    return (n * bits + 7) // 8  # dtype-exact: s4/u4 pack two per byte
 
 
 def _shape_bytes(shape: str) -> int:

@@ -373,11 +373,20 @@ def render_report(ledger: Ledger) -> str:
             g = r.get("goodput", {}) or {}
             mfu = g.get("mfu")
             dec = g.get("decomposition", {}) or {}
+            # active quantization knobs, when the run recorded them: the
+            # wire format and (for tiered runs) the host-master storage dtype
+            dtypes = ""
+            if r.get("comm_dtype"):
+                dtypes += f"  wire={r['comm_dtype']}"
+            t = r.get("tiered")
+            if isinstance(t, dict) and t.get("master_dtype"):
+                dtypes += f"  tier_master={t['master_dtype']}"
             lines.append(
                 f"  {r.get('ts', '?')}  model={r.get('model')}  "
                 f"steps={r.get('steps')}  items={r.get('items')}  "
                 f"config_hash={r.get('config_hash', '?')}  "
                 f"mfu={'%.3g' % mfu if isinstance(mfu, (int, float)) else 'n/a'}"
+                + dtypes
             )
             if dec:
                 lines.append(
@@ -421,6 +430,18 @@ def render_report(ledger: Ledger) -> str:
                     f"parity={t.get('parity_bit_identical')}  "
                     f"over_budget_round_trip={t.get('round_trip_ok')}"
                 )
+                q = t.get("quantized")
+                if isinstance(q, dict):
+                    lines.append(
+                        f"    quantized[{q.get('master_dtype')}]: "
+                        f"capacity={q.get('capacity_ratio_vs_f32')}x f32  "
+                        f"rel_err={q.get('master_rel_err_vs_f32')}  "
+                        f"digests_clean={q.get('digests_clean')}  "
+                        f"serve_requant_exact={q.get('serve_requant_exact')}  "
+                        f"ok={q.get('ok')}"
+                    )
+            elif t.get("master_dtype"):
+                lines.append(f"    master_dtype={t['master_dtype']}")
             bd = t.get("breakdown")
             if isinstance(bd, dict) and any(
                     bd.get(k) for k in ("plan_ns", "fault_ns", "flush_ns",
@@ -445,6 +466,8 @@ def render_report(ledger: Ledger) -> str:
     for r in runs:
         pl = r.get("placement")
         if isinstance(pl, dict):
+            if r.get("comm_dtype"):
+                pl = {**pl, "comm_dtype": r["comm_dtype"]}
             placement_rows.append((r.get("ts", "?"), "run  ", pl, None))
     for r in ledger.records("bench"):
         p = r.get("payload") if isinstance(r.get("payload"), dict) else {}
@@ -464,6 +487,8 @@ def render_report(ledger: Ledger) -> str:
                 f"replicated_rows={pl.get('replicated_rows', pl.get('cut'))}  "
                 f"coverage="
                 + (f"{cov:.3f}" if isinstance(cov, (int, float)) else "n/a")
+                + (f"  wire={pl['comm_dtype']}" if pl.get("comm_dtype")
+                   else "")
             )
             if pl.get("reason"):
                 lines.append(f"    reason: {pl['reason']}")
@@ -703,7 +728,10 @@ def check_regression(
         p_rc, p_msg = _check_placement_regression(ledger)
         if p_msg:
             msg = f"{msg}\n{p_msg}"
-        return max(2, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc), msg
+        q_rc, q_msg = _check_quantized_wire_regression(ledger)
+        if q_msg:
+            msg = f"{msg}\n{q_msg}"
+        return max(2, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -731,7 +759,10 @@ def check_regression(
             p_rc, p_msg = _check_placement_regression(ledger)
             if p_msg:
                 msg = f"{msg}\n{p_msg}"
-            return max(0, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc), msg
+            q_rc, q_msg = _check_quantized_wire_regression(ledger)
+            if q_msg:
+                msg = f"{msg}\n{q_msg}"
+            return max(0, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -766,7 +797,10 @@ def check_regression(
     p_rc, p_msg = _check_placement_regression(ledger)
     if p_msg:
         msg = f"{msg}\n{p_msg}"
-    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc), msg
+    q_rc, q_msg = _check_quantized_wire_regression(ledger)
+    if q_msg:
+        msg = f"{msg}\n{q_msg}"
+    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -864,6 +898,54 @@ def _check_placement_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
         f"placement ok: skewed-lane exchange reduction >= "
         f"{_SKEWED_EXCHANGE_FLOOR:.1f}x at every comm dtype "
         f"(worst {worst:.2f}x)"
+    )
+
+
+# the int4 wire must keep its audited exchange-byte win vs the f32 wire on
+# the scaling lane (codes pack two per byte; scales ride as bf16 words),
+# and its short-run loss must stay within 1% of the f32 lane's
+_INT4_PAYLOAD_FLOOR = 6.0
+_INT4_LOSS_PARITY_MAX = 0.01
+
+
+def _check_quantized_wire_regression(
+    ledger: Ledger,
+) -> Tuple[int, Optional[str]]:
+    """Gate the int4 wire on the scaling lane: the newest bench record whose
+    ``scaling.per_dtype`` carries an ``int4`` row must show an audited
+    exchange-byte reduction vs the f32 wire of at least
+    ``_INT4_PAYLOAD_FLOOR`` with loss parity within
+    ``_INT4_LOSS_PARITY_MAX``. The bytes are compiled-HLO collective shapes
+    (platform-independent), so CPU lane runs gate the same as the placement
+    check. No int4 history gates nothing."""
+    with_int4 = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("scaling"), dict)
+        and isinstance(r["payload"]["scaling"].get("per_dtype"), dict)
+        and isinstance(
+            r["payload"]["scaling"]["per_dtype"].get("int4"), dict)
+    ]
+    if not with_int4:
+        return 0, None
+    row = with_int4[-1]["payload"]["scaling"]["per_dtype"]["int4"]
+    red = row.get("payload_reduction_vs_f32")
+    parity = row.get("loss_parity_vs_f32")
+    problems = []
+    if not (isinstance(red, (int, float)) and red >= _INT4_PAYLOAD_FLOOR):
+        problems.append(
+            f"audited exchange-byte reduction {red} vs f32 is below the "
+            f"{_INT4_PAYLOAD_FLOOR:.1f}x floor")
+    if not (isinstance(parity, (int, float))
+            and parity <= _INT4_LOSS_PARITY_MAX):
+        problems.append(
+            f"loss parity {parity} vs f32 exceeds the "
+            f"{_INT4_LOSS_PARITY_MAX} bar")
+    if problems:
+        return 1, "int4-wire REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"int4-wire ok: exchange bytes {red:.2f}x below f32 "
+        f"(floor {_INT4_PAYLOAD_FLOOR:.1f}x), loss parity {parity}"
     )
 
 
@@ -1096,6 +1178,22 @@ def _check_tiered_regression(
         return 1, (
             "tiered REGRESSION: newest lane record failed bit-parity or the "
             "over-budget round trip (correctness gate)")
+    # quantized-master (int8) leg: correctness + capacity, any platform.
+    # Older records without the block are not gated on it.
+    q = newest_rec["payload"]["tiered"].get("quantized")
+    if isinstance(q, dict) and not q.get("ok"):
+        bad = [k for k in ("digests_clean", "serve_requant_exact",
+                           "checkpoint_dtype_f32") if not q.get(k)]
+        cap = q.get("capacity_ratio_vs_f32")
+        if not (isinstance(cap, (int, float)) and cap >= 2.0):
+            bad.append(f"capacity_ratio_vs_f32={cap} (floor 2.0x)")
+        err = q.get("master_rel_err_vs_f32")
+        budget = q.get("rel_err_budget", 0.05)
+        if not (isinstance(err, (int, float)) and err <= budget):
+            bad.append(f"master_rel_err_vs_f32={err} (budget {budget})")
+        return 1, (
+            "tiered REGRESSION: quantized-master (int8) leg failed: "
+            + ", ".join(bad or ["ok flag unset"]))
     ratio = newest_rec["payload"]["tiered"].get("tiered_over_resident")
     if isinstance(ratio, (int, float)) and ratio < _TIERED_RESIDENT_FLOOR:
         return 1, (
@@ -1122,6 +1220,8 @@ def _check_tiered_regression(
     return 0, (
         f"tiered ok: {wps:,.1f} words/s vs baseline {base:,.1f} "
         f"({(wps / base - 1) * 100:+.1f}%), parity ok ({platform or '?'})"
+        + (f", int8 masters {q.get('capacity_ratio_vs_f32')}x capacity"
+           if isinstance(q, dict) else "")
     )
 
 

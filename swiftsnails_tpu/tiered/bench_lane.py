@@ -17,6 +17,14 @@ One implementation used by ``bench.py --lane tiered`` and
   bit-parity of the checkpointed masters against a resident control run and
   on served pulls matching the masters exactly.
 
+- **quantized-master**: the over-budget schedule again with
+  ``tier_master_dtype: int8`` — masters stored as int8 code planes +
+  per-row scales. Readouts: capacity-per-GB vs the logical f32 layout
+  (>= 2x), keyed-digest integrity through the async flush queue, master
+  drift vs the f32-master control, and the f32-checkpoint round trip
+  (quantized tiers still write plain f32 checkpoints; a served pull must
+  equal the deterministic requant->dequant of the checkpointed rows).
+
 The block lands in the bench JSON (``tiered``), the run ledger, and the
 ``ledger-report --check-regression`` gate (words/sec floor + parity flags).
 """
@@ -32,6 +40,10 @@ import numpy as np
 
 TIERED_SEED = 13
 OVER_BUDGET_FACTOR = 4  # master units per cache slot in the over-budget leg
+# run-end master drift budget for the int8-master leg vs the f32-master
+# control: per-row int8 steps are ~amax/127, so the accumulated requant
+# dither stays a small fraction of the table scale
+QUANTIZED_REL_ERR_MAX = 0.05
 
 
 def _corpus(small: bool, vocab_n: int) -> Tuple[np.ndarray, "object"]:
@@ -153,6 +165,9 @@ def tiered_bench(small: bool = False, workdir: Optional[str] = None,
         # -- over-budget leg: vocab 4x the cache, full round trip ------------
         ob = _over_budget_leg(corpus, workdir, over, vocab_n, dim)
 
+        # -- quantized-master leg: int8 masters on the same schedule ---------
+        qb = _quantized_master_leg(corpus, workdir, over, vocab_n, dim)
+
         block = {
             "small": bool(small),
             "vocab": vocab_n,
@@ -167,6 +182,8 @@ def tiered_bench(small: bool = False, workdir: Optional[str] = None,
             "breakdown": breakdown,
             "over_budget": ob,
             "round_trip_ok": bool(ob.get("round_trip_ok")),
+            "quantized": qb,
+            "quantized_ok": bool(qb.get("ok")),
             "elapsed_s": round(time.monotonic() - t_lane0, 1),
         }
         if ledger is not None:
@@ -242,4 +259,96 @@ def _over_budget_leg(corpus, workdir: str, over: Dict, vocab_n: int,
         "serve_pull_ok": serve_ok,
         "serve_hit_rate": serve_stats.get("hit_rate"),
         "round_trip_ok": bool(parity and serve_ok and ck_step > 0),
+    }
+
+
+def _quantized_master_leg(corpus, workdir: str, over: Dict, vocab_n: int,
+                          dim: int) -> Dict:
+    """Over-budget leg with ``tier_master_dtype: int8``: same schedule, but
+    the host masters live as int8 code planes + per-row f32 scales, so the
+    same host RAM holds >= 2x the rows. The checkpoint stays plain f32
+    (dequantized before the manifest), and the serving reload requantizes
+    deterministically — a served pull must equal the requant->dequant of the
+    checkpointed rows bit-exactly."""
+    from swiftsnails_tpu.framework.trainer import TrainLoop
+    from swiftsnails_tpu.serving.engine import Servant
+    from swiftsnails_tpu.tiered.store import (
+        _np_dequant_unit_rows, _np_quant_unit_rows,
+    )
+
+    slots = max(vocab_n // OVER_BUDGET_FACTOR, 1)
+    budget = _budget_mb(vocab_n, dim, slots)
+    steps = 16
+    over = {**over, "batch_size": 32 if vocab_n <= 512 else 64,
+            "negatives": 2}
+    tier_base = {
+        **over, "table_tier": "host", "tier_hbm_budget_mb": budget,
+        "tier_async_flush": 1,
+    }
+
+    # f32-master control on the identical schedule: the drift reference
+    f32_state = TrainLoop(_make_trainer(
+        corpus, tempfile.mkdtemp(dir=workdir), **tier_base)[0],
+        log_every=0).run(seed=0, max_steps=steps)
+
+    ck_root = os.path.join(workdir, "ckpt-q8")
+    q_tr, q_cfg = _make_trainer(
+        corpus, tempfile.mkdtemp(dir=workdir), **tier_base,
+        tier_master_dtype="int8", param_backup_root=ck_root,
+        param_backup_period=steps // 2)
+    q_loop = TrainLoop(q_tr, log_every=0)
+    q_state = q_loop.run(seed=0, max_steps=steps)
+    summary = q_loop.tier.summary()
+    # digest sweep AFTER the async flush queue drained: the incremental
+    # keyed digests must cover code planes and scale sidebands through
+    # every coalesced scatter
+    digests_clean = not q_loop.tier.verify()
+
+    # capacity: stored bytes per unit (codes + scales) vs the logical f32
+    # layout the budget math still sizes the HBM cache with
+    tables = summary.get("tables") or {}
+    ratios = [
+        t["unit_bytes"] / t["host_unit_bytes"]
+        for t in tables.values() if t.get("host_unit_bytes")
+    ]
+    capacity_ratio = round(min(ratios), 3) if ratios else None
+    rows_per_gb = {
+        name: int((1 << 30) // t["host_unit_bytes"])
+        for name, t in tables.items() if t.get("host_unit_bytes")
+    }
+
+    a = np.asarray(q_state.in_table.table, dtype=np.float64)
+    b = np.asarray(f32_state.in_table.table, dtype=np.float64)
+    rel_err = float(np.abs(a - b).mean() / max(np.abs(b).mean(), 1e-12))
+
+    rng = np.random.default_rng(TIERED_SEED)
+    probe = rng.integers(0, vocab_n, size=256).astype(np.int64)
+    with Servant.from_checkpoint(ck_root, q_cfg, cache_rows=0) as served:
+        ck_step = served.step
+        pulled = served.pull(probe, table="in_table")
+    want = np.asarray(q_state.in_table.table)[probe]
+    codes, scales = _np_quant_unit_rows(want)
+    expect = _np_dequant_unit_rows(codes, scales, want.dtype)
+    serve_ok = bool(np.array_equal(pulled, expect))
+    ckpt_f32 = str(np.asarray(q_state.in_table.table).dtype) == "float32"
+
+    ok = bool(
+        digests_clean and serve_ok and ckpt_f32 and ck_step > 0
+        and capacity_ratio is not None and capacity_ratio >= 2.0
+        and rel_err <= QUANTIZED_REL_ERR_MAX
+    )
+    return {
+        "master_dtype": summary.get("master_dtype"),
+        "steps": steps,
+        "checkpoint_step": ck_step,
+        "capacity_ratio_vs_f32": capacity_ratio,
+        "rows_per_gb": rows_per_gb,
+        "hit_rate": summary.get("hit_rate"),
+        "async_flush": summary.get("async_flush"),
+        "digests_clean": digests_clean,
+        "master_rel_err_vs_f32": round(rel_err, 6),
+        "rel_err_budget": QUANTIZED_REL_ERR_MAX,
+        "serve_requant_exact": serve_ok,
+        "checkpoint_dtype_f32": ckpt_f32,
+        "ok": ok,
     }

@@ -33,7 +33,7 @@ import numpy as np
 import jax
 
 from swiftsnails_tpu.tiered.store import (
-    HostMaster, TieredTable, TierStats, _FlushQueue,
+    HostMaster, TieredTable, TierStats, _FlushQueue, resolve_master_dtype,
 )
 from swiftsnails_tpu.utils.config import ConfigError
 
@@ -64,6 +64,11 @@ class TierManager:
             _AUTO_DEPTH_START if self.prefetch_auto
             else cfg.get_int("tier_prefetch_depth", 2))
         self.checksums = cfg.get_bool("tier_checksums", True)
+        # tier_master_dtype: int8 stores the host masters as code planes +
+        # per-unit scales (tiered/store.py) — the HBM cache, checkpoints,
+        # and every other surface stay f32
+        self.master_dtype = resolve_master_dtype(
+            cfg.get_str("tier_master_dtype", "float32"))
         self.async_flush = cfg.get_bool("tier_async_flush", True)
         self.flush_batch = cfg.get_int("tier_flush_batch", 8)
         if self.flush_batch <= 0:
@@ -103,7 +108,9 @@ class TierManager:
             info = self.spec[name]
             master = HostMaster(
                 st, info["layout"], group=int(info.get("group", 1)),
-                checksums=self.checksums)
+                checksums=self.checksums, master_dtype=self.master_dtype)
+            # budget math stays in LOGICAL bytes: the HBM cache holds f32
+            # rows regardless of how narrow the host storage is
             units = int(budget_each * (1 << 20) // max(master.unit_nbytes, 1))
             tt = TieredTable(
                 master, units, mesh=self.trainer.mesh, name=name,
@@ -362,11 +369,13 @@ class TierManager:
         out["prefetch_depth"] = self.prefetch_depth
         out["prefetch_auto"] = self.prefetch_auto
         out["transparent"] = self.all_transparent
+        out["master_dtype"] = self.master_dtype
         out["tables"] = {
             name: {
                 "budget_slots": tt.budget,
                 "master_units": tt.master.units,
                 "unit_bytes": tt.master.unit_nbytes,
+                "host_unit_bytes": tt.master.host_unit_nbytes,
                 "resident": int((tt.unit_of >= 0).sum()),
                 "dirty": int(tt.dirty.sum()),
             }

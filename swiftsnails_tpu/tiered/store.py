@@ -163,23 +163,117 @@ def _rows_hash(rows: np.ndarray, weights: np.ndarray) -> int:
     return int((flat.astype(np.uint64) * weights).sum(dtype=np.uint64))
 
 
+MASTER_DTYPES = ("float32", "int8")
+
+
+def resolve_master_dtype(name: Optional[str]) -> str:
+    """Validate / canonicalize a ``tier_master_dtype`` config value."""
+    if not name:
+        return "float32"
+    canon = {"float32": "float32", "f32": "float32",
+             "int8": "int8", "s8": "int8"}.get(str(name).strip().lower())
+    if canon is None:
+        raise ValueError(
+            f"tier_master_dtype must be one of {MASTER_DTYPES}, got {name!r}")
+    return canon
+
+
+def _np_hash_uniform(units: np.ndarray, gens: np.ndarray, per: int) -> np.ndarray:
+    """Deterministic uniform[0,1) dither [n, per] keyed by (unit id,
+    quantization generation, element position) — the NumPy twin of
+    ``parallel.comm._hash_uniform``, so master re-quantization is
+    reproducible given the scatter history while stays unbiased over
+    positions and generations."""
+    u = np.asarray(units, np.uint64).astype(np.uint32)
+    g = np.asarray(gens, np.uint64).astype(np.uint32)
+    seed = (u * np.uint32(2654435761) + g * np.uint32(0x9E3779B9))
+    x = np.arange(per, dtype=np.uint32)[None, :] * np.uint32(2654435761)
+    x = x + seed[:, None]
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x7FEB352D)
+    x = x ^ (x >> np.uint32(15))
+    x = x * np.uint32(0x846CA68B)
+    x = x ^ (x >> np.uint32(16))
+    return x.astype(np.float64) * (1.0 / 4294967296.0)
+
+
+def _np_quant_unit_rows(rows: np.ndarray, dither: Optional[np.ndarray] = None
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-unit symmetric int8 of ``[n, ...]`` f32 rows -> (codes int8 of
+    ``rows.shape``, scales f32 [n] = unit_amax/127; all-zero units get zero
+    scale). ``dither`` switches round-to-nearest to unbiased floor(y + u)."""
+    n = rows.shape[0]
+    flat = np.asarray(rows, np.float32).reshape(n, -1)
+    amax = np.abs(flat).max(axis=1) if flat.size else np.zeros(n, np.float32)
+    scale = (amax / np.float32(127.0)).astype(np.float32)
+    inv = np.divide(np.float32(1.0), scale, where=scale > 0,
+                    out=np.zeros_like(scale))
+    y = flat * inv[:, None]
+    y = np.rint(y) if dither is None else np.floor(y + dither)
+    codes = np.clip(y, -127, 127).astype(np.int8).reshape(rows.shape)
+    return codes, scale
+
+
+def _np_dequant_unit_rows(codes: np.ndarray, scales: np.ndarray,
+                          dtype) -> np.ndarray:
+    """int8 codes + per-unit scales -> rows of the logical dtype."""
+    n = codes.shape[0]
+    shape = (n,) + (1,) * (codes.ndim - 1)
+    return (codes.astype(np.float32)
+            * np.asarray(scales, np.float32).reshape(shape)).astype(dtype)
+
+
 class HostMaster:
     """NumPy master plane for one table: the same (table, slots) leaves as
     the device state, full size, host-resident. ``group`` is the number of
     logical rows per cache unit (1 except the packed-small plane, where one
-    unit is a ``[S, 128]`` tile holding G rows)."""
+    unit is a ``[S, 128]`` tile holding G rows).
+
+    ``master_dtype: int8`` stores every float plane as int8 codes plus one
+    f32 scale per unit (``amax/127`` over the unit's elements), roughly
+    quadrupling the vocab a host holds at fixed RAM. The quantization is
+    invisible outside this class: :meth:`gather` dequantizes into the
+    logical (f32) dtype the HBM cache uses, :meth:`scatter` re-quantizes
+    with a deterministic hash dither keyed by (unit, per-unit quantization
+    generation) so repeated flush round trips stay unbiased, and
+    :meth:`state` / :meth:`reload` speak full-precision pytrees — the
+    on-disk checkpoint format is byte-identical to an f32-master run.
+    Integrity digests cover the code planes AND the scale sidebands
+    (``<plane>/scale``), both maintained incrementally through scatter."""
 
     def __init__(self, state, layout: str, group: int = 1,
-                 checksums: bool = True):
+                 checksums: bool = True, master_dtype: str = "float32"):
         self.kind = type(state)  # TableState | PackedTableState
         self.layout = layout
         self.group = int(group)
+        self.master_dtype = resolve_master_dtype(master_dtype)
         # owned, writable copies: device_get hands back views onto read-only
         # buffers, and the masters are mutated in place by every write-back
-        self.table = np.array(jax.device_get(state.table))
-        self.slots = {
+        table = np.array(jax.device_get(state.table))
+        slots = {
             k: np.array(jax.device_get(v)) for k, v in state.slots.items()
         }
+        # logical dtypes: what gather/state hand out and what the cache
+        # plane is made of — the stored planes may be narrower (int8 codes)
+        self.table_dtype = table.dtype
+        self.slot_dtypes = {k: v.dtype for k, v in slots.items()}
+        self.quantized = self.master_dtype == "int8"
+        # per-plane per-unit f32 scale sidebands (quantized masters only),
+        # keyed by plane name; per-unit quantization-generation counter
+        # salts the scatter-path dither so every re-quantization of a unit
+        # draws fresh (but replayable) noise
+        self.scales: Dict[str, np.ndarray] = {}
+        self._qgen: Optional[np.ndarray] = None
+        if self.quantized:
+            self._qgen = np.zeros(table.shape[0], np.uint32)
+            self.table, self.scales["table"] = _np_quant_unit_rows(table)
+            self.slots = {}
+            for k, v in slots.items():
+                self.slots[k], self.scales[f"slots/{k}"] = (
+                    _np_quant_unit_rows(v))
+        else:
+            self.table = table
+            self.slots = slots
         # per-plane integrity digests: a keyed wraparound sum of per-unit
         # hashes, maintained incrementally through scatter() so a direct
         # memory corruption (bit rot, a stray write bypassing scatter) is
@@ -195,6 +289,11 @@ class HostMaster:
         yield "table", self.table
         for k in sorted(self.slots):
             yield f"slots/{k}", self.slots[k]
+        # the scale sidebands are part of the master's content: a flipped
+        # scale bit corrupts every element of its unit on dequant, so the
+        # digests (and the bitflip chaos drill) must cover them too
+        for p in sorted(self.scales):
+            yield f"{p}/scale", self.scales[p][:, None]
 
     def _plane_weights(self, plane: str, arr: np.ndarray) -> np.ndarray:
         per = int(np.prod(arr.shape[1:], dtype=np.int64)) * arr.dtype.itemsize
@@ -246,11 +345,23 @@ class HostMaster:
 
     def reload(self, state) -> None:
         """Replace the master content wholesale (quarantine-and-rebuild path:
-        the caller restored a verified checkpoint) and re-seed the digests."""
+        the caller restored a verified checkpoint) and re-seed the digests.
+        Quantized masters re-quantize deterministically (round-to-nearest):
+        the heal path must be reproducible, and a reload is a single
+        conversion, not a repeated round trip that needs dithering."""
         tab = state["table"] if isinstance(state, dict) else state.table
         slots = state["slots"] if isinstance(state, dict) else state.slots
-        self.table = np.array(jax.device_get(tab))
-        self.slots = {k: np.array(jax.device_get(v)) for k, v in slots.items()}
+        table = np.array(jax.device_get(tab))
+        slots = {k: np.array(jax.device_get(v)) for k, v in slots.items()}
+        if self.quantized:
+            self.table, self.scales["table"] = _np_quant_unit_rows(table)
+            self.slots = {}
+            for k, v in slots.items():
+                self.slots[k], self.scales[f"slots/{k}"] = (
+                    _np_quant_unit_rows(v))
+        else:
+            self.table = table
+            self.slots = slots
         if self._digests is not None:
             self._init_digests()
 
@@ -260,22 +371,91 @@ class HostMaster:
 
     @property
     def unit_nbytes(self) -> int:
+        """LOGICAL bytes per unit — the size of the full-precision rows this
+        master hands the HBM cache. TierManager sizes the device budget off
+        this, so it must not shrink when the host storage narrows."""
+        per = int(np.prod(self.table.shape[1:], dtype=np.int64)) or 1
+        n = per * self.table_dtype.itemsize
+        for k, v in self.slots.items():
+            sper = int(np.prod(v.shape[1:], dtype=np.int64)) or 1
+            n += sper * self.slot_dtypes[k].itemsize
+        return n
+
+    @property
+    def host_unit_nbytes(self) -> int:
+        """STORED bytes per unit in host RAM (codes + scale sidebands for a
+        quantized master) — the capacity-per-GB readout the tiered bench
+        reports. Equals :attr:`unit_nbytes` for f32 masters."""
         per = int(np.prod(self.table.shape[1:], dtype=np.int64)) or 1
         n = per * self.table.dtype.itemsize
         for v in self.slots.values():
             sper = int(np.prod(v.shape[1:], dtype=np.int64)) or 1
             n += sper * v.dtype.itemsize
+        for s in self.scales.values():
+            n += s.dtype.itemsize
         return n
 
     def gather(self, units: np.ndarray) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
-        return self.table[units], {k: v[units] for k, v in self.slots.items()}
+        if not self.quantized:
+            return self.table[units], {k: v[units] for k, v in self.slots.items()}
+        t = _np_dequant_unit_rows(self.table[units],
+                                  self.scales["table"][units],
+                                  self.table_dtype)
+        s = {
+            k: _np_dequant_unit_rows(v[units], self.scales[f"slots/{k}"][units],
+                                     self.slot_dtypes[k])
+            for k, v in self.slots.items()
+        }
+        return t, s
 
     def scatter(self, units: np.ndarray, table_rows: np.ndarray,
                 slot_rows: Dict[str, np.ndarray]) -> None:
         """Write units back into the masters. ``units`` must be unique (every
         call site flushes a slot map, which is injective) — the incremental
-        digest update assumes each unit's old bytes are replaced once."""
+        digest update assumes each unit's old bytes are replaced once.
+
+        Quantized masters re-quantize here with a hash dither keyed by
+        (unit, generation): unbiased over repeated flush round trips, yet
+        deterministic given the scatter history — and order-independent
+        across async flush coalescing, because the unique-units contract
+        means each unit's generation advances exactly once per landing."""
         units = np.asarray(units)
+        if self.quantized and units.size:
+            gens = self._qgen[units]
+            per = int(np.prod(self.table.shape[1:], dtype=np.int64)) or 1
+            codes, scales = _np_quant_unit_rows(
+                np.asarray(table_rows, np.float32),
+                _np_hash_uniform(units, gens, per))
+            new_slot: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+            for k, v in slot_rows.items():
+                sper = int(np.prod(self.slots[k].shape[1:],
+                                   dtype=np.int64)) or 1
+                # salt the generation per plane so planes draw distinct noise
+                new_slot[k] = _np_quant_unit_rows(
+                    np.asarray(v, np.float32),
+                    _np_hash_uniform(units, gens + np.uint32(0x85EBCA6B),
+                                     sper))
+            if self._digests is not None:
+                self._digest_swap("table", self.table, units,
+                                  self.table[units], codes)
+                self._digest_swap("table/scale", self.scales["table"][:, None],
+                                  units, self.scales["table"][units, None],
+                                  scales[:, None])
+                for k, (c, s) in new_slot.items():
+                    self._digest_swap(f"slots/{k}", self.slots[k], units,
+                                      self.slots[k][units], c)
+                    self._digest_swap(f"slots/{k}/scale",
+                                      self.scales[f"slots/{k}"][:, None],
+                                      units,
+                                      self.scales[f"slots/{k}"][units, None],
+                                      s[:, None])
+            self.table[units] = codes
+            self.scales["table"][units] = scales
+            for k, (c, s) in new_slot.items():
+                self.slots[k][units] = c
+                self.scales[f"slots/{k}"][units] = s
+            self._qgen[units] += 1
+            return
         if self._digests is not None and units.size:
             self._digest_swap("table", self.table, units,
                               self.table[units], table_rows)
@@ -290,8 +470,18 @@ class HostMaster:
         """The full-size state pytree (NumPy leaves) — what checkpoints save
         and what the trainer gets back at end of run. Same NamedTuple type,
         shapes, and dtypes as the resident device state, so the on-disk
-        checkpoint format is unchanged."""
-        return self.kind(table=self.table, slots=dict(self.slots))
+        checkpoint format is unchanged: quantized masters dequantize BEFORE
+        the manifest ever sees a plane (f32 in, f32 out)."""
+        if not self.quantized:
+            return self.kind(table=self.table, slots=dict(self.slots))
+        table = _np_dequant_unit_rows(self.table, self.scales["table"],
+                                      self.table_dtype)
+        slots = {
+            k: _np_dequant_unit_rows(v, self.scales[f"slots/{k}"],
+                                     self.slot_dtypes[k])
+            for k, v in self.slots.items()
+        }
+        return self.kind(table=table, slots=slots)
 
 
 class _FlushQueue:
@@ -478,9 +668,10 @@ class TieredTable:
         slots are never read (pulls only see slots the fault path installed),
         so zeros are safe and skip the RNG init cost."""
         shape = (self.budget,) + self.master.table.shape[1:]
-        table = jnp.zeros(shape, self.master.table.dtype)
+        table = jnp.zeros(shape, self.master.table_dtype)
         slots = {
-            k: jnp.zeros((self.budget,) + v.shape[1:], v.dtype)
+            k: jnp.zeros((self.budget,) + v.shape[1:],
+                         self.master.slot_dtypes[k])
             for k, v in self.master.slots.items()
         }
         if self.mesh is not None:
@@ -673,16 +864,20 @@ class TieredTable:
         if self._rowdma is None:
             from swiftsnails_tpu.ops import rowdma
 
-            planes = [self.master.table] + [
-                self.master.slots[k] for k in sorted(self.master.slots)]
+            # shapes come from the stored planes (identical either way);
+            # dtypes must be the LOGICAL ones — the gathered fault payload a
+            # quantized master hands over is already dequantized to f32
+            planes = [(self.master.table, self.master.table_dtype)] + [
+                (self.master.slots[k], self.master.slot_dtypes[k])
+                for k in sorted(self.master.slots)]
             self._rowdma = (
                 self.mesh is None
                 and (rowdma.on_tpu() or self.rowdma_interpret)
                 and all(
                     p.ndim == 3
                     and p.shape[-1] == rowdma.ROW_LANES
-                    and p.dtype == self.master.table.dtype
-                    for p in planes)
+                    and dt == self.master.table_dtype
+                    for p, dt in planes)
             )
         return self._rowdma
 
@@ -705,7 +900,7 @@ class TieredTable:
         buf = self._staging.get(b)
         if buf is None or buf.shape != (b, total, lanes):
             buf = self._staging[b] = np.zeros(
-                (b, total, lanes), self.master.table.dtype)
+                (b, total, lanes), self.master.table_dtype)
         off = 0
         for name, s in spans:
             rows = table_rows if name == "table" else slot_rows[name]
@@ -863,8 +1058,10 @@ class TieredTable:
             self.master.reload(cache)
             self.stats.flushes += 1
             self.stats.flushed_rows += self.used
-            self.stats.d2h_bytes += self.master.table.nbytes + sum(
-                v.nbytes for v in self.master.slots.values())
+            # what moved D2H is the f32 cache plane, not the (possibly
+            # narrower) stored master bytes
+            self.stats.d2h_bytes += (
+                self.master.units * self.master.unit_nbytes)
             self.stats.flush_ns += time.monotonic_ns() - t0
             return
         d = np.nonzero(self.dirty)[0]

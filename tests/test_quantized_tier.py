@@ -1,0 +1,294 @@
+"""Quantized host masters (``tier_master_dtype: int8``).
+
+The storage contract: masters live as int8 code planes + per-row f32 scale
+sidebands (>= 2x rows per host GB), the keyed digests cover BOTH planes
+incrementally through scatter, re-quantization is deterministic given the
+unit's write generation (replay/heal reproducibility), and everything
+outside the host store stays f32 — the HBM cache, ``state()``, and every
+checkpoint (dequant-before-manifest), so a quantized run's checkpoints are
+format-identical to a resident run's.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from swiftsnails_tpu.framework.quality import paired_corpus
+from swiftsnails_tpu.framework.trainer import TrainLoop
+from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+from swiftsnails_tpu.parallel.store import TableState
+from swiftsnails_tpu.tiered.store import (
+    HostMaster,
+    _np_dequant_unit_rows,
+    _np_quant_unit_rows,
+    resolve_master_dtype,
+)
+from swiftsnails_tpu.utils.config import Config
+
+
+def _state(n=32, d=8, seed=0, with_slots=True):
+    rng = np.random.default_rng(seed)
+    slots = {}
+    if with_slots:
+        slots["m"] = rng.normal(size=(n, d)).astype(np.float32)
+    return TableState(table=rng.normal(size=(n, d)).astype(np.float32),
+                      slots=slots)
+
+
+def test_resolve_master_dtype():
+    assert resolve_master_dtype(None) == "float32"
+    assert resolve_master_dtype("float32") == "float32"
+    assert resolve_master_dtype("f32") == "float32"
+    assert resolve_master_dtype("int8") == "int8"
+    assert resolve_master_dtype("s8") == "int8"
+    with pytest.raises(ValueError):
+        resolve_master_dtype("int4")
+
+
+def test_capacity_at_least_2x_and_budget_math_unchanged():
+    st = _state()
+    f32 = HostMaster(_state(), "dense")
+    q = HostMaster(st, "dense", master_dtype="int8")
+    # logical bytes (TierManager budget math sizes the f32 HBM cache with
+    # this) must NOT shrink when the host storage narrows
+    assert q.unit_nbytes == f32.unit_nbytes
+    # stored bytes (codes + scale sidebands) must be >= 2x smaller
+    assert f32.host_unit_nbytes >= 2 * q.host_unit_nbytes
+    assert q.table.dtype == np.int8
+
+
+def test_gather_dequant_error_bound():
+    st = _state(seed=1)
+    want = st.table.copy()
+    q = HostMaster(_state(seed=1), "dense", master_dtype="int8")
+    units = np.arange(want.shape[0])
+    t_rows, _ = q.gather(units)
+    step = np.abs(want).max(axis=1, keepdims=True) / 127.0
+    assert t_rows.dtype == want.dtype
+    assert np.all(np.abs(np.asarray(t_rows) - want) <= 0.5 * step + 1e-7)
+
+
+def test_digest_detects_code_and_scale_flips():
+    """A single bit flip in EITHER the int8 code plane or a scale sideband
+    must be named by verify() — silent scale corruption would rescale a
+    whole row without touching any code byte."""
+    m = HostMaster(_state(seed=2), "dense", master_dtype="int8")
+    assert m.verify() == []
+    m.table.view(np.uint8).reshape(-1)[7] ^= 1 << 2
+    assert "table" in m.verify()
+
+    m2 = HostMaster(_state(seed=2), "dense", master_dtype="int8")
+    m2.scales["table"].view(np.uint8)[9] ^= 1 << 4
+    assert "table/scale" in m2.verify()
+
+    m3 = HostMaster(_state(seed=2), "dense", master_dtype="int8")
+    m3.scales["slots/m"].view(np.uint8)[3] ^= 1 << 1
+    assert "slots/m/scale" in m3.verify()
+
+
+def test_scatter_keeps_incremental_digests_consistent():
+    """The keyed digests are swapped per-unit through scatter (codes AND
+    scales); a full recompute afterwards must agree — no drift between the
+    incremental path and the ground truth."""
+    m = HostMaster(_state(seed=3), "dense", master_dtype="int8")
+    rng = np.random.default_rng(4)
+    for i in range(5):
+        units = np.unique(rng.integers(0, 32, 6))
+        t_rows = rng.normal(size=(len(units), 8)).astype(np.float32)
+        s_rows = {"m": rng.normal(size=(len(units), 8)).astype(np.float32)}
+        m.scatter(units, t_rows, s_rows)
+    assert m.verify() == []
+    # the written rows survive a gather within the quantization step
+    units = np.arange(8)
+    t_rows, _ = m.gather(units)
+    assert np.all(np.isfinite(np.asarray(t_rows)))
+
+
+def test_scatter_requant_deterministic_given_generation():
+    """Two masters replaying the identical scatter sequence must hold
+    bit-identical codes + scales (the dither is keyed by unit x write
+    generation, not wall clock), and a unit's generation advances so a
+    rewrite of the same value can round differently."""
+    def replay():
+        m = HostMaster(_state(seed=5), "dense", master_dtype="int8")
+        rng = np.random.default_rng(6)
+        for _ in range(4):
+            units = np.unique(rng.integers(0, 32, 8))
+            t = rng.normal(size=(len(units), 8)).astype(np.float32)
+            s = {"m": rng.normal(size=(len(units), 8)).astype(np.float32)}
+            m.scatter(units, t, s)
+        return m
+
+    a, b = replay(), replay()
+    np.testing.assert_array_equal(a.table, b.table)
+    np.testing.assert_array_equal(a.scales["table"], b.scales["table"])
+    for k in a.slots:
+        np.testing.assert_array_equal(a.slots[k], b.slots[k])
+    assert np.array_equal(a._qgen, b._qgen) and a._qgen.max() > 0
+
+
+def test_state_dequantizes_to_f32_and_reload_requants():
+    """state() hands back plain f32 leaves (what checkpoints see); reload of
+    those leaves reproduces the stored codes exactly (round-to-nearest is
+    a fixed point on already-dequantized rows up to scale re-derivation)."""
+    m = HostMaster(_state(seed=7), "dense", master_dtype="int8")
+    out = m.state()
+    assert np.asarray(out.table).dtype == np.float32
+    for v in out.slots.values():
+        assert np.asarray(v).dtype == np.float32
+    m2 = HostMaster(_state(seed=8), "dense", master_dtype="int8")
+    m2.reload(out)
+    assert m2.verify() == []
+    out2 = m2.state()
+    # parity of the second trip vs the first: within one code step
+    step = np.abs(np.asarray(out.table)).max(axis=1, keepdims=True) / 127.0
+    assert np.all(np.abs(np.asarray(out2.table) - np.asarray(out.table))
+                  <= step + 1e-7)
+
+
+def test_np_quant_helpers_round_trip_and_zero_rows():
+    rows = np.random.default_rng(9).normal(size=(6, 16)).astype(np.float32)
+    rows[2] = 0.0
+    codes, scales = _np_quant_unit_rows(rows)
+    assert codes.dtype == np.int8 and scales[2] == 0.0
+    assert np.all(codes[2] == 0)
+    back = _np_dequant_unit_rows(codes, scales, np.float32)
+    step = np.abs(rows).max(axis=1, keepdims=True) / 127.0
+    assert np.all(np.abs(back - rows) <= 0.5 * step + 1e-7)
+
+
+# ------------------------------------------------ training + checkpoints ---
+
+
+def _budget_mb(slots: int, dim: int, tables: int = 2) -> float:
+    return tables * slots * dim * 4 / float(1 << 20)
+
+
+def _make(tier_slots=None, dim=8, corpus=None, master_dtype=None, **over):
+    ids, vocab = corpus if corpus is not None else paired_corpus(
+        n_pairs=8, reps=400, seed=0)
+    cfg = Config({
+        "dim": str(dim), "window": "1", "negatives": "1",
+        "learning_rate": "0.5", "num_iters": "4", "batch_size": "1",
+        "subsample": "0", "seed": "0", "packed": "0", "steps_per_call": "1",
+    })
+    for k, v in over.items():
+        cfg.set(k, str(v))
+    if tier_slots is not None:
+        cfg.set("table_tier", "host")
+        cfg.set("tier_hbm_budget_mb", str(_budget_mb(tier_slots, dim)))
+    if master_dtype is not None:
+        cfg.set("tier_master_dtype", master_dtype)
+    return Word2VecTrainer(cfg, mesh=None, corpus_ids=ids, vocab=vocab)
+
+
+def test_quantized_run_trains_with_async_flush_and_clean_digests():
+    """An int8-master run under a tiny budget (constant evict + write-back
+    through the background flusher) stays finite, close to the f32-master
+    run, and every digest verifies after the final drain."""
+    steps = 24
+    f32 = TrainLoop(_make(tier_slots=4, tier_async_flush=1), log_every=0)
+    a = f32.run(seed=0, max_steps=steps)
+    q = TrainLoop(_make(tier_slots=4, tier_async_flush=1,
+                        master_dtype="int8"), log_every=0)
+    b = q.run(seed=0, max_steps=steps)
+    assert q.tier.summary()["master_dtype"] == "int8"
+    assert q.tier.summary()["async_flush"] is True
+    assert q.tier.verify() == {}
+    at, bt = np.asarray(a.in_table.table), np.asarray(b.in_table.table)
+    rel = np.abs(at - bt).mean() / max(np.abs(at).mean(), 1e-12)
+    assert np.all(np.isfinite(bt)) and rel < 0.05, rel
+
+
+def test_quantized_checkpoint_is_format_identical_f32(tmp_path):
+    """Satellite contract: a ``tier_master_dtype: int8`` run writes
+    checkpoints in the SAME f32 on-disk format as an f32-master run — same
+    table keys, shapes, and dtypes (dequant-before-manifest) — and the
+    arrays equal the dequantized masters bit-exactly."""
+    from swiftsnails_tpu.framework.checkpoint import load_tables
+
+    corpus = paired_corpus(n_pairs=8, reps=400, seed=0)
+    steps = 8
+    roots = {}
+    states = {}
+    for tag, md in (("f32", None), ("int8", "int8")):
+        root = str(tmp_path / tag)
+        states[tag] = TrainLoop(
+            _make(tier_slots=4, corpus=corpus, master_dtype=md,
+                  param_backup_root=root, param_backup_period=steps // 2),
+            log_every=0).run(seed=0, max_steps=steps)
+        roots[tag] = root
+    a, _ = load_tables(roots["f32"], step=steps)
+    b, _ = load_tables(roots["int8"], step=steps)
+    assert set(a) == set(b)
+    for name in a:
+        x, y = np.asarray(a[name]["table"]), np.asarray(b[name]["table"])
+        assert x.shape == y.shape and x.dtype == y.dtype == np.float32
+    # the quantized run's checkpoint IS its dequantized master state
+    np.testing.assert_array_equal(
+        np.asarray(b["in_table"]["table"]),
+        np.asarray(states["int8"].in_table.table))
+
+
+def test_f32_ckpt_int8_tier_f32_ckpt_round_trip(tmp_path):
+    """f32-ckpt -> int8-tier -> f32-ckpt: resume an f32 run's checkpoint
+    into a quantized-tier run; the adopt-time requantization must land each
+    row within half an int8 step of the restored value (recorded parity),
+    and a second trip through the same quantizer moves nothing further than
+    one more step (the codes have converged)."""
+    root = str(tmp_path / "ck")
+    corpus = paired_corpus(n_pairs=8, reps=400, seed=0)
+    steps = 8
+    f32_state = TrainLoop(
+        _make(corpus=corpus, param_backup_root=root,
+              param_backup_period=steps // 2),
+        log_every=0).run(seed=0, max_steps=steps)
+    want = np.asarray(f32_state.in_table.table)
+
+    # adopt the f32 rows into a quantized master and write them back out
+    m = HostMaster(TableState(table=want.copy(), slots={}), "dense",
+                   master_dtype="int8")
+    trip1 = np.asarray(m.state().table)
+    step = np.abs(want).max(axis=1, keepdims=True) / 127.0
+    parity = np.abs(trip1 - want)
+    assert np.all(parity <= 0.5 * step + 1e-7), parity.max()
+    m2 = HostMaster(TableState(table=trip1.copy(), slots={}), "dense",
+                    master_dtype="int8")
+    trip2 = np.asarray(m2.state().table)
+    assert np.all(np.abs(trip2 - trip1) <= step + 1e-7)
+
+
+def test_quantized_serving_pull_matches_requant(tmp_path):
+    """Serve a quantized-tier checkpoint: pulls flow through the int8
+    master, so they must equal the deterministic requant->dequant of the
+    checkpointed f32 rows bit-exactly."""
+    from swiftsnails_tpu.serving.engine import Servant
+
+    root = str(tmp_path / "ck")
+    corpus = paired_corpus(n_pairs=8, reps=400, seed=0)
+    steps = 8
+    tr = _make(tier_slots=4, corpus=corpus, master_dtype="int8",
+               param_backup_root=root, param_backup_period=steps // 2)
+    state = TrainLoop(tr, log_every=0).run(seed=0, max_steps=steps)
+    # probe within the serving replica's own 4-slot budget per pull
+    probe = np.arange(4, dtype=np.int64)
+    with Servant.from_checkpoint(root, tr.config, cache_rows=0) as served:
+        pulled = served.pull(probe, table="in_table")
+    want = np.asarray(state.in_table.table)[probe]
+    codes, scales = _np_quant_unit_rows(want)
+    np.testing.assert_array_equal(
+        pulled, _np_dequant_unit_rows(codes, scales, want.dtype))
+
+
+def test_bitflip_drill_int8_recovers(tmp_path):
+    """The canned tier bit-rot drill over int8 masters: detect (code plane
+    or scale sideband), quarantine, heal from the newest verified
+    checkpoint, finish with loss parity."""
+    from swiftsnails_tpu.resilience.drill import drill_tier_bitflip_int8
+
+    res = drill_tier_bitflip_int8(str(tmp_path))
+    assert res["recovered"], res
+    assert res["master_dtype"] == "int8"
+    probe = res.get("plane_probe") or {}
+    assert probe.get("code_detected") and probe.get("scale_detected"), res

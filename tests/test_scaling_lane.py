@@ -50,17 +50,20 @@ def test_scaling_lane_smoke(isolated_bench):
     assert block["n_devices"] == 8
     assert block["mesh"] == {"data": 2, "model": 4}
     per = block["per_dtype"]
-    assert set(per) == {"float32", "bfloat16", "int8"}
+    assert set(per) == {"float32", "bfloat16", "int8", "int4"}
     for entry in per.values():
         assert entry["aggregate_words_per_sec"] > 0
         assert entry["scaling_efficiency"] > 0
         assert entry["exchange_bytes_per_step"] > 0
-    # the acceptance bars: >=1.9x payload cut for bf16, >=3x for int8, and
-    # short-run loss parity within 1% of f32 on the CPU-smoke config
+    # the acceptance bars: >=1.9x payload cut for bf16, >=3x for int8,
+    # >=6x for int4 (block-wise codes+scales on the packed grouped plane),
+    # and short-run loss parity within 1% of f32 on the CPU-smoke config
     assert per["bfloat16"]["payload_reduction_vs_f32"] >= 1.9
     assert per["int8"]["payload_reduction_vs_f32"] >= 3.0
+    assert per["int4"]["payload_reduction_vs_f32"] >= 6.0
     assert per["bfloat16"]["loss_parity_vs_f32"] <= 0.01
     assert per["int8"]["loss_parity_vs_f32"] <= 0.02
+    assert per["int4"]["loss_parity_vs_f32"] <= 0.01
     # gateable headline numbers mirror the f32 lane
     assert block["aggregate_words_per_sec"] == \
         per["float32"]["aggregate_words_per_sec"]

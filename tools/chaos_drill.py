@@ -4,11 +4,13 @@ unrecovered fault.
 
 The drills (``swiftsnails_tpu/resilience/drill.py``) inject every fault the
 resilience stack claims to survive — NaN/Inf gradient bursts, a poisoned
-parameter row, a transient data-stream I/O error, checkpoint bit rot, and a
-simulated preemption — and assert the run *recovers*: guardrail rollback
-with zero non-finite values reaching the master tables, retry instead of
-crash, manifest-verified walk-back, and a resumed run whose final loss
-matches an undisturbed one.
+parameter row, a transient data-stream I/O error, checkpoint bit rot, a
+simulated preemption, and tiered-master bit rot over both f32 and int8
+(quantized) host masters, where the flip may land in a code plane or a
+scale sideband — and assert the run *recovers*: guardrail rollback with
+zero non-finite values reaching the master tables, retry instead of crash,
+manifest-verified walk-back, digest-detected quarantine-and-heal, and a
+resumed run whose final loss matches an undisturbed one.
 
     python tools/chaos_drill.py            # the full matrix
     python tools/chaos_drill.py --fast     # the tier-1 subset
