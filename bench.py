@@ -129,6 +129,7 @@ _state = {
     "scaling": None,  # multi-chip throughput lane (dict; see measure_scaling)
     "chaos": None,  # resilience lane (dict; see measure_chaos / --lane chaos)
     "serving": None,  # read-path latency lane (dict; see --lane serve)
+    "fleet": None,  # replica-pool QPS-at-SLO lane (dict; see --lane fleet)
     "tiered": None,  # host-tier parameter store lane (dict; see --lane tiered)
     "chaos_serve": None,  # serving availability drill (dict; --lane chaos-serve)
     "chaos_cluster": None,  # cluster membership drill (dict; --lane chaos-cluster)
@@ -240,6 +241,7 @@ def _result_json(extra_error=None):
             "scaling": _state["scaling"],
             "chaos": _state["chaos"],
             "serving": _state["serving"],
+            "fleet": _state["fleet"],
             "tiered": _state["tiered"],
             "chaos_serve": _state["chaos_serve"],
             "chaos_cluster": _state["chaos_cluster"],
@@ -1362,6 +1364,63 @@ def run_serve_lane() -> int:
     return 0
 
 
+# -- fleet (replica pool) lane -------------------------------------------------
+#
+# `--lane fleet` measures the serving fleet (`swiftsnails_tpu/serving/
+# fleet.py`): max sustainable QPS at a fixed p99 SLO for 1 vs N replicas
+# under an open-loop zipf workload, with device service time modeled as an
+# injected per-dispatch stall so the lane measures the routing machinery
+# (affinity, spill, hedging, queueing) and is valid on CPU. Two controlled
+# comparisons ride along: affinity vs random routing (aggregate LRU hit
+# rate) and hedge vs no-hedge with one stalling replica (p99). The block
+# lands in the result JSON (`fleet`), the run ledger, and the
+# `ledger-report --check-regression` gate (QPS floor + p99 SLO ceiling +
+# scaling floor).
+
+
+def measure_fleet() -> None:
+    """Populate ``_state['fleet']`` with the replica-pool lane block."""
+    from swiftsnails_tpu.serving.fleet_lane import fleet_bench
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    block = fleet_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["fleet"] = block
+    print(
+        f"bench: fleet lane: fleet qps {block.get('qps')} "
+        f"(single {block.get('single', {}).get('max_qps')}, "
+        f"scaling {block.get('scaling_x')}x) "
+        f"p99 {block.get('p99_ms')}ms @ SLO {block.get('slo_p99_ms')}ms "
+        f"affinity {block.get('affinity', {}).get('affinity_hit_rate')} "
+        f"vs random {block.get('affinity', {}).get('random_hit_rate')}",
+        file=sys.stderr,
+    )
+
+
+def run_fleet_lane() -> int:
+    """``--lane fleet``: the replica-pool lane alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "fleet"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_fleet()
+    except Exception as e:
+        _state["errors"].append(
+            f"fleet lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["fleet"]
+    # the lane's headline is the fleet's max sustainable QPS at the p99 SLO
+    _state["best"] = block.get("qps") or 0.0
+    _state["best_path"] = "fleet-pull"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    return 0
+
+
 # -- tiered (host parameter store) lane ---------------------------------------
 #
 # `--lane tiered` measures the tiered parameter store (`swiftsnails_tpu/
@@ -1888,8 +1947,8 @@ def main(argv=None):
         prog="bench", description="word2vec words/sec/chip benchmark")
     parser.add_argument(
         "--lane",
-        choices=("full", "scaling", "chaos", "serve", "tiered", "chaos-serve",
-                 "chaos-cluster"),
+        choices=("full", "scaling", "chaos", "serve", "fleet", "tiered",
+                 "chaos-serve", "chaos-cluster"),
         default="full",
         help="full = the headline bench (default); scaling = the scale-out "
              "lane alone (grouped-mesh 1-vs-N throughput per comm_dtype plus "
@@ -1899,6 +1958,9 @@ def main(argv=None):
              "lane alone (guardrail overhead + scripted-fault recovery "
              "drills; valid on CPU); serve = the read-path latency lane "
              "(pull/top-k/CTR-score qps + p50/p95/p99; valid on CPU); "
+             "fleet = the replica-pool lane (max sustainable QPS at a fixed "
+             "p99 SLO for 1 vs N replicas behind the affinity/hedging "
+             "router, open-loop zipf load; valid on CPU); "
              "tiered = the host-tier parameter store lane (words/sec vs "
              "resident + over-budget round trip; valid on CPU); chaos-serve "
              "= the serving availability drill (fault matrix vs a live "
@@ -1918,6 +1980,8 @@ def main(argv=None):
         return run_chaos_lane()
     if args.lane == "serve":
         return run_serve_lane()
+    if args.lane == "fleet":
+        return run_fleet_lane()
     if args.lane == "tiered":
         return run_tiered_lane()
     if args.lane == "chaos-serve":

@@ -12,6 +12,7 @@ Usage::
     python -m swiftsnails_tpu train  -config train.conf [-data corpus.txt]
     python -m swiftsnails_tpu export -config train.conf -checkpoint ROOT -out vec.txt
     python -m swiftsnails_tpu serve  -config train.conf -checkpoint ROOT   # query REPL
+    python -m swiftsnails_tpu serve  ... -replicas 4   # replica fleet behind the router
     python -m swiftsnails_tpu models
     python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
@@ -122,25 +123,44 @@ def cmd_serve(argv: List[str]) -> int:
         score <f0> <f1> ...          CTR probability (registry models)
         stats                        latency/cache/shed snapshot
         health                       breaker / tier / version state
+        add                          (fleet) add a replica to the ring
+        drain <replica>              (fleet) drain + remove a replica
         quit
+
+    ``-replicas N`` (or config ``serve_replicas``) > 1 serves through a
+    :class:`~swiftsnails_tpu.serving.fleet.Fleet` — N replicas sharing the
+    loaded planes behind the affinity/hedging router; the same REPL ops
+    work (``Fleet`` mirrors the ``Servant`` query surface) plus elastic
+    ``add``/``drain``, and ``health`` reports fleet-level liveness.
     """
     import json
 
-    from swiftsnails_tpu.serving import Overloaded, Servant, Unavailable
+    from swiftsnails_tpu.serving import Fleet, Overloaded, Servant, Unavailable
     from swiftsnails_tpu.telemetry.ledger import Ledger
 
     cfg = parse_role_argv(argv)
     root = cfg.get_str("checkpoint")
     ledger_path = cfg.get_str("ledger_path", "")
     ledger = Ledger(ledger_path) if ledger_path else None
-    with Servant.from_checkpoint(root, cfg, mesh=_serve_mesh(cfg),
-                                 ledger=ledger) as servant:
-        print(
-            f"serving step {servant.step} tables "
-            f"{servant.stats()['tables']} (one request per line; "
-            "pull/topk/score/stats/health/quit)",
-            file=sys.stderr,
-        )
+    replicas = cfg.get_int("replicas", cfg.get_int("serve_replicas", 1))
+    fleet_mode = replicas > 1
+    if fleet_mode:
+        server_cm = Fleet.from_checkpoint(
+            root, cfg, mesh=_serve_mesh(cfg), replicas=replicas,
+            ledger=ledger)
+    else:
+        server_cm = Servant.from_checkpoint(
+            root, cfg, mesh=_serve_mesh(cfg), ledger=ledger)
+    with server_cm as servant:
+        if fleet_mode:
+            banner = (f"serving fleet of {replicas} replicas "
+                      f"(one request per line; pull/topk/score/stats/"
+                      "health/add/drain/quit)")
+        else:
+            banner = (f"serving step {servant.step} tables "
+                      f"{servant.stats()['tables']} (one request per line; "
+                      "pull/topk/score/stats/health/quit)")
+        print(banner, file=sys.stderr)
         for line in sys.stdin:
             toks = line.split()
             if not toks:
@@ -165,6 +185,10 @@ def cmd_serve(argv: List[str]) -> int:
                     out = servant.stats()
                 elif op == "health":
                     out = servant.health()
+                elif op == "add" and fleet_mode:
+                    out = {"added": servant.add_replica()}
+                elif op == "drain" and fleet_mode:
+                    out = {"drained": servant.drain(args[0])}
                 else:
                     out = {"error": f"unknown op {op!r}"}
             except Overloaded as e:

@@ -5,7 +5,9 @@ jitted query kernels — row pull, top-k nearest-neighbor, CTR score — behind
 a micro-batcher with a hot-row LRU cache and bounded-queue admission
 control. Availability hardening: per-kernel circuit breakers with
 degraded-mode (stale-LRU) reads and typed :class:`Unavailable` sheds.
-See ``docs/SERVING.md``.
+Horizontal scale: a :class:`Fleet` of replicas sharing the loaded planes
+behind a consistent-hash affinity router with bounded spill, tail-latency
+hedging, and elastic add/drain. See ``docs/SERVING.md``.
 """
 
 from swiftsnails_tpu.serving.breaker import CircuitBreaker, Unavailable
@@ -17,6 +19,15 @@ from swiftsnails_tpu.serving.engine import (
     bucket_for,
     normalize_table,
 )
+from swiftsnails_tpu.serving.fleet import Fleet, Replica
+from swiftsnails_tpu.serving.loadgen import run_open_loop
+from swiftsnails_tpu.serving.router import (
+    EwmaQuantile,
+    HashRing,
+    HedgeGovernor,
+    route_hash,
+    spill_order,
+)
 from swiftsnails_tpu.serving.kernels import (
     ctr_logits,
     ctr_scores,
@@ -26,9 +37,14 @@ from swiftsnails_tpu.serving.kernels import (
 
 __all__ = [
     "CircuitBreaker",
+    "EwmaQuantile",
+    "Fleet",
+    "HashRing",
+    "HedgeGovernor",
     "HotRowCache",
     "MicroBatcher",
     "Overloaded",
+    "Replica",
     "Servant",
     "Unavailable",
     "bucket_for",
@@ -36,5 +52,8 @@ __all__ = [
     "ctr_scores",
     "normalize_table",
     "pull_rows",
+    "route_hash",
+    "run_open_loop",
+    "spill_order",
     "topk_tiled",
 ]

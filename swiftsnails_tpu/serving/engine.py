@@ -230,6 +230,13 @@ class MicroBatcher:
             self._cv.notify()
         return req
 
+    @property
+    def depth(self) -> int:
+        """Requests queued but not yet taken by the dispatcher — the load
+        signal the fleet router's bounded spill keys on. A racy snapshot by
+        design (len() on a deque is atomic under CPython)."""
+        return len(self._queue)
+
     def _take_batch(self) -> List[_Request]:
         """Drain queued requests up to the largest bucket's unit budget."""
         batch: List[_Request] = []
@@ -965,6 +972,12 @@ class Servant:
 
     def shed_count(self) -> int:
         return int(self.registry.counter("serve.shed").value)
+
+    def queue_depths(self) -> Dict[str, int]:
+        """Per-kernel admission-queue depth right now — the introspection
+        surface the fleet router (and the serve REPL's ``stats``) reads to
+        decide when an owner replica is deep enough to spill past."""
+        return {k: b.depth for k, b in self._batchers.items()}
 
     def reset_metrics(self) -> None:
         for d in self._latency.values():

@@ -458,6 +458,54 @@ def render_report(ledger: Ledger) -> str:
                        if "flush_queue_depth" in bd else "")
                 )
 
+    # serving fleet: bench records carry the `fleet` lane block (replica
+    # pool QPS at the p99 SLO, per-replica split, hedge + affinity legs)
+    fleet_rows = []
+    for r in ledger.records("bench"):
+        p = r.get("payload") if isinstance(r.get("payload"), dict) else {}
+        fb = (p or {}).get("fleet")
+        if isinstance(fb, dict):
+            fleet_rows.append((r.get("ts", "?"), fb))
+    if fleet_rows:
+        lines.append("")
+        lines.append("serving fleet (newest last):")
+        for ts, fb in fleet_rows[-5:]:
+            single = fb.get("single") or {}
+            lines.append(
+                f"  {ts}  fleet={_fmt_num(fb.get('qps', 0))} qps "
+                f"(single={_fmt_num(single.get('max_qps', 0))}, "
+                f"scaling={fb.get('scaling_x')}x, "
+                f"floor {fb.get('scaling_floor')}x)  "
+                f"p99={fb.get('p99_ms')}ms @ SLO {fb.get('slo_p99_ms')}ms  "
+                f"replicas={fb.get('replicas')}"
+            )
+            per = fb.get("fleet", {}).get("per_replica") \
+                if isinstance(fb.get("fleet"), dict) else None
+            if isinstance(per, dict):
+                for rid, row in sorted(per.items()):
+                    lines.append(
+                        f"    {rid}: {_fmt_num(row.get('qps', 0))} qps  "
+                        f"p99={row.get('p99_ms')}ms  "
+                        f"requests={row.get('requests')}  "
+                        f"cache_hit_rate={row.get('cache_hit_rate')}"
+                    )
+            aff = fb.get("affinity")
+            if isinstance(aff, dict):
+                lines.append(
+                    f"    affinity: hit_rate={aff.get('affinity_hit_rate')} "
+                    f"vs random={aff.get('random_hit_rate')} "
+                    f"@ {_fmt_num(aff.get('offered_qps', 0))} qps"
+                )
+            hg = fb.get("hedge")
+            if isinstance(hg, dict):
+                lines.append(
+                    f"    hedge: p99={hg.get('p99_ms')}ms vs "
+                    f"no-hedge={hg.get('nohedge_p99_ms')}ms  "
+                    f"rate={hg.get('hedge_rate_pct')}% "
+                    f"(budget {hg.get('budget_pct')}%)  "
+                    f"won={hg.get('hedge_won')}/{hg.get('hedged')}"
+                )
+
     # hybrid placement: run records carry a `placement` decision when the
     # mode was hybrid/auto (including auto runs that resolved back to
     # uniform, with the reason); bench records carry the skewed scaling
@@ -540,7 +588,8 @@ def render_report(ledger: Ledger) -> str:
 # chaos drill making it go wrong on purpose), interleaved with run records
 # for context — `ledger-report --failures`
 FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
-                 "retry_exhausted", "breaker", "degraded", "membership")
+                 "retry_exhausted", "breaker", "degraded", "membership",
+                 "hedge", "drain")
 
 
 def _failure_line(r: Dict) -> str:
@@ -597,6 +646,29 @@ def _failure_line(r: Dict) -> str:
             f"  {ts}  DEGRADED kernel={r.get('kernel')} "
             f"reason={r.get('reason')} rows={r.get('rows')} "
             f"total={r.get('degraded_total')}"
+        )
+    if kind == "hedge":
+        # the fleet router's rate-limited tail-hedge stream (first + every
+        # 100th, like the engine's overload/degraded streams)
+        return (
+            f"  {ts}  HEDGE    kernel={r.get('kernel')} "
+            f"{r.get('primary')}->{r.get('hedge')} "
+            f"budget={_fmt_num(r.get('budget_ms', 0))}ms "
+            f"total={r.get('hedged_total')} "
+            f"rate={r.get('hedge_rate_pct')}%"
+        )
+    if kind == "drain":
+        if r.get("phase") == "complete":
+            return (
+                f"  {ts}  DRAIN    {r.get('replica')} complete "
+                f"waited={_fmt_num(r.get('waited_ms', 0))}ms "
+                f"clean={r.get('clean')} "
+                f"remaining={r.get('remaining_replicas')}"
+            )
+        return (
+            f"  {ts}  DRAIN    {r.get('replica')} start "
+            f"inflight={r.get('inflight')} "
+            f"remaining={r.get('remaining_replicas')}"
         )
     if kind == "membership":
         # the cluster supervisor's lifecycle timeline (cluster/supervisor.py)
@@ -716,6 +788,9 @@ def check_regression(
         v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
         if v_msg:
             msg = f"{msg}\n{v_msg}"
+        f_rc, f_msg = _check_fleet_regression(ledger, max_drop_pct)
+        if f_msg:
+            msg = f"{msg}\n{f_msg}"
         t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
         if t_msg:
             msg = f"{msg}\n{t_msg}"
@@ -731,7 +806,7 @@ def check_regression(
         q_rc, q_msg = _check_quantized_wire_regression(ledger)
         if q_msg:
             msg = f"{msg}\n{q_msg}"
-        return max(2, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
+        return max(2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -747,6 +822,9 @@ def check_regression(
             v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
             if v_msg:
                 msg = f"{msg}\n{v_msg}"
+            f_rc, f_msg = _check_fleet_regression(ledger, max_drop_pct)
+            if f_msg:
+                msg = f"{msg}\n{f_msg}"
             t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
             if t_msg:
                 msg = f"{msg}\n{t_msg}"
@@ -762,7 +840,7 @@ def check_regression(
             q_rc, q_msg = _check_quantized_wire_regression(ledger)
             if q_msg:
                 msg = f"{msg}\n{q_msg}"
-            return max(0, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
+            return max(0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -785,6 +863,9 @@ def check_regression(
     v_rc, v_msg = _check_serving_regression(ledger, max_drop_pct)
     if v_msg:
         msg = f"{msg}\n{v_msg}"
+    f_rc, f_msg = _check_fleet_regression(ledger, max_drop_pct)
+    if f_msg:
+        msg = f"{msg}\n{f_msg}"
     t_rc, t_msg = _check_tiered_regression(ledger, max_drop_pct)
     if t_msg:
         msg = f"{msg}\n{t_msg}"
@@ -800,7 +881,7 @@ def check_regression(
     q_rc, q_msg = _check_quantized_wire_regression(ledger)
     if q_msg:
         msg = f"{msg}\n{q_msg}"
-    return max(rc, s_rc, c_rc, v_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
+    return max(rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -1135,6 +1216,100 @@ def _check_serving_regression(
     return 0, (
         f"serving ok: pull {qps:,.1f} qps / p99 {p99}ms vs "
         f"qps baseline {base_qps:,.1f} ({platform or '?'})"
+    )
+
+
+def _fleet_values(record: Dict) -> Optional[Tuple[float, Optional[float]]]:
+    """(fleet qps, p99_ms) from a bench payload's ``fleet`` block, or None
+    when the fleet lane didn't run in that record."""
+    f = record.get("payload", {}).get("fleet")
+    if not isinstance(f, dict):
+        return None
+    qps = f.get("qps")
+    if not (isinstance(qps, (int, float)) and qps > 0):
+        return None
+    p99 = f.get("p99_ms")
+    p99 = float(p99) if isinstance(p99, (int, float)) and p99 > 0 else None
+    return float(qps), p99
+
+
+def _check_fleet_regression(
+    ledger: Ledger, max_drop_pct: float
+) -> Tuple[int, Optional[str]]:
+    """Gate the fleet lane alongside the perf headline. Four checks on the
+    newest bench record carrying a ``fleet`` block:
+
+    * p99 at the reported max must be inside the lane's SLO and the
+      scaling ratio at/above the lane's floor (1.6x for 2 replicas) — the
+      router's whole job, platform-independent, so CPU lane runs gate;
+    * affinity routing's aggregate LRU hit rate must beat random spray on
+      the same zipf traffic (the warm-cache win the ring exists for);
+    * hedging must not make the stalled-replica leg's p99 worse than its
+      no-hedge control at equal offered load;
+    * fleet qps must hold its floor vs the best earlier record of the
+      *same platform* (absolute qps is machine-bound, like the serve gate).
+
+    No fleet history gates nothing."""
+    with_fleet = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict) and _fleet_values(r)
+    ]
+    if not with_fleet:
+        return 0, None
+    newest_rec = with_fleet[-1]
+    fb = newest_rec["payload"]["fleet"]
+    qps, p99 = _fleet_values(newest_rec)
+    problems = []
+    slo = fb.get("slo_p99_ms")
+    if isinstance(slo, (int, float)) and p99 is not None and p99 > slo:
+        problems.append(
+            f"p99 {p99:.2f}ms at the reported max exceeds the "
+            f"{slo}ms SLO")
+    scaling = fb.get("scaling_x")
+    floor_x = fb.get("scaling_floor", 1.6)
+    if int(fb.get("replicas") or 0) >= 2 and not (
+            isinstance(scaling, (int, float)) and scaling >= floor_x):
+        problems.append(
+            f"scaling {scaling}x for {fb.get('replicas')} replicas is "
+            f"below the {floor_x}x floor")
+    aff = fb.get("affinity")
+    if isinstance(aff, dict):
+        a, rnd = aff.get("affinity_hit_rate"), aff.get("random_hit_rate")
+        if not (isinstance(a, (int, float)) and isinstance(rnd, (int, float))
+                and a > rnd):
+            problems.append(
+                f"affinity hit rate {a} does not beat random routing {rnd}")
+    hg = fb.get("hedge")
+    if isinstance(hg, dict):
+        hp, cp = hg.get("p99_ms"), hg.get("nohedge_p99_ms")
+        if not (isinstance(hp, (int, float)) and isinstance(cp, (int, float))
+                and hp <= cp):
+            problems.append(
+                f"hedged p99 {hp}ms is worse than the no-hedge control "
+                f"{cp}ms")
+    platform = newest_rec["payload"].get("platform")
+    same = [r for r in with_fleet
+            if r["payload"].get("platform") == platform]
+    earlier = [_fleet_values(r)[0] for r in same[:-1]]
+    if earlier:
+        base = max(earlier)
+        qps_floor = base * (1.0 - max_drop_pct / 100.0)
+        if qps < qps_floor:
+            problems.append(
+                f"fleet qps {qps:,.1f} is {(1 - qps / base) * 100:.1f}% "
+                f"below baseline {base:,.1f} (allowed {max_drop_pct:.1f}%)")
+    if problems:
+        return 1, "fleet REGRESSION: " + "; ".join(problems)
+    if not earlier:
+        return 0, (
+            f"fleet: single {platform or '?'} record ({qps:,.1f} qps, "
+            f"scaling {scaling}x, p99 {p99}ms <= SLO {slo}ms); "
+            "qps floor has nothing to compare against"
+        )
+    return 0, (
+        f"fleet ok: {qps:,.1f} qps (scaling {scaling}x >= {floor_x}x, "
+        f"p99 {p99}ms <= SLO {slo}ms) vs qps baseline {max(earlier):,.1f} "
+        f"({platform or '?'})"
     )
 
 

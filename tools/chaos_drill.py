@@ -17,6 +17,7 @@ resumed run whose final loss matches an undisturbed one.
     python tools/chaos_drill.py --json     # machine-readable results
     python tools/chaos_drill.py --serve    # the serving availability matrix
     python tools/chaos_drill.py --cluster  # the membership drill matrix
+    python tools/chaos_drill.py --fleet    # the replica-fleet drill matrix
 
 ``--serve`` runs the CPU-valid availability drill instead (the bench
 ``chaos-serve`` lane): a seeded fault matrix against a live Servant with
@@ -25,6 +26,13 @@ floor while the unprotected control leg hard-fails, a corrupt checkpoint
 must be rejected by the shadow-verify reload, and the tiered bit-flip
 drill must detect + rebuild with loss parity. Exit is nonzero on a missed
 floor or any failed drill.
+
+``--fleet`` runs the CPU-valid replica-fleet drill matrix instead: one
+replica of a 2-replica :class:`Fleet` gets sick mid-storm — killed with
+``serve_io_error`` dispatch faults (its breakers trip and the router walks
+around it) or slowed with ``serve_slow`` stalls (tail hedges rescue the
+stragglers) — and the fleet must hold the availability floor through
+breaker-aware re-routing + hedging. Exit is nonzero on a missed floor.
 
 ``--cluster`` runs the CPU-valid membership drill matrix instead (the bench
 ``chaos-cluster`` lane, one fault kind per drill): a simulated virtual-clock
@@ -87,6 +95,34 @@ def _serve_matrix(args) -> int:
     return 1 if failed else 0
 
 
+def _fleet_matrix(args) -> int:
+    from swiftsnails_tpu.serving.fleet_lane import fleet_chaos_drill
+
+    results = fleet_chaos_drill(small=True, workdir=args.workdir)
+    failed = [k for k, v in results.items() if not v.get("recovered")]
+    if args.json:
+        print(json.dumps({"results": results, "failed": failed}))
+    else:
+        width = max(len(k) for k in results)
+        for name, res in results.items():
+            status = "RECOVERED" if res.get("recovered") else "UNRECOVERED"
+            detail = (
+                f"availability={res['availability_pct']:.1f}% "
+                f"(floor {res['floor_pct']:.1f}%) "
+                f"p99={res['p99_ms']}ms "
+                f"reroutes={res['reroutes']} "
+                f"hedged={res['hedged']} hedge_won={res['hedge_won']} "
+                f"victim={res['victim']} "
+                f"breaker_trips={res['victim_breaker_trips']}"
+            )
+            print(f"{name:<{width}}  {status:<11}  {detail}")
+        print(
+            f"{len(results) - len(failed)}/{len(results)} drills recovered"
+            + (f"; FAILED: {', '.join(failed)}" if failed else "")
+        )
+    return 1 if failed else 0
+
+
 def _cluster_matrix(args) -> int:
     from swiftsnails_tpu.cluster.chaos_lane import run_cluster_drills
 
@@ -134,12 +170,18 @@ def main(argv=None) -> int:
                    help="run the cluster membership drill matrix instead "
                         "(kill/straggle/partition vs the supervisor; nonzero "
                         "exit on lost/duplicated batches or missed recovery)")
+    p.add_argument("--fleet", action="store_true",
+                   help="run the replica-fleet drill matrix instead (kill/"
+                        "slow one replica mid-storm; the fleet must hold the "
+                        "availability floor via re-route + hedging)")
     args = p.parse_args(argv)
 
     if args.serve:
         return _serve_matrix(args)
     if args.cluster:
         return _cluster_matrix(args)
+    if args.fleet:
+        return _fleet_matrix(args)
 
     from swiftsnails_tpu.resilience.drill import run_drill_matrix
 

@@ -14,10 +14,13 @@ derived view of it. This tool renders the history — and gates CI:
     # the pinned baseline (default: best earlier measured ledger record;
     # pin explicitly with --baseline VALUE or --baseline-file FILE).
     # Also gates the scaling lane's aggregate words/sec, the chaos lane's
-    # recovery (unrecovered drill / resume-parity breach fails CI), and
-    # the tiered lane: bit-parity / round-trip failure is fatal on any
+    # recovery (unrecovered drill / resume-parity breach fails CI), the
+    # tiered lane (bit-parity / round-trip failure is fatal on any
     # platform, words/sec gates per platform, and the equal-vocab
-    # tiered/resident ratio has a hard 0.95x floor
+    # tiered/resident ratio has a hard 0.95x floor), and the fleet lane:
+    # p99 over the SLO, 2-replica scaling under the floor, affinity not
+    # beating random, or hedging not cutting p99 is fatal on any
+    # platform; fleet max QPS gates per platform
     python tools/ledger_report.py --check-regression 10
 
     # failure timeline: outage / chaos-injection / black-box / checkpoint
