@@ -133,7 +133,8 @@ _state = {
     "tiered": None,  # host-tier parameter store lane (dict; see --lane tiered)
     "chaos_serve": None,  # serving availability drill (dict; --lane chaos-serve)
     "chaos_cluster": None,  # cluster membership drill (dict; --lane chaos-cluster)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster)
+    "freshness": None,  # trainer->fleet delta pipeline lane (dict; --lane freshness)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -245,6 +246,7 @@ def _result_json(extra_error=None):
             "tiered": _state["tiered"],
             "chaos_serve": _state["chaos_serve"],
             "chaos_cluster": _state["chaos_cluster"],
+            "freshness": _state["freshness"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1594,6 +1596,75 @@ def run_chaos_cluster_lane() -> int:
     return 0 if block.get("recovered") else 1
 
 
+# -- freshness (trainer -> fleet delta pipeline) lane --------------------------
+#
+# `--lane freshness` runs the hot-row delta pipeline (`swiftsnails_tpu/
+# freshness/`): train to a checkpoint, serve it from a 2-replica fleet, then
+# resume training with `freshness_publish: 1` while a DeltaSubscriber applies
+# every version-stamped batch under concurrent open-loop load. Gates: delta-
+# applied rows bit-identical to the same-watermark checkpoint, delta lag p99
+# under the lane ceiling, serve p99 within the SLO while applying, and a
+# forced-gap drill recovering via the full-reload fallback. Correctness is
+# platform-independent, so the lane is valid on CPU; the block lands in the
+# result JSON (`freshness`), the run ledger, and the `ledger-report
+# --check-regression` gate.
+
+
+def measure_freshness() -> None:
+    """Populate ``_state['freshness']`` with the delta-pipeline lane block."""
+    from swiftsnails_tpu.freshness.bench_lane import freshness_bench
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    block = freshness_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["freshness"] = block
+    print(
+        f"bench: freshness lane: lag p99 {block.get('lag_p99_ms')}ms "
+        f"(ceiling {block.get('lag_ceiling_ms')}ms) "
+        f"serve p99 {block.get('serve_p99_ms')}ms "
+        f"(SLO {block.get('slo_p99_ms')}ms) "
+        f"bit parity {block.get('bit_parity')} "
+        f"gap drill recovered {(block.get('gap_drill') or {}).get('recovered')}",
+        file=sys.stderr,
+    )
+
+
+def run_freshness_lane() -> int:
+    """``--lane freshness``: the delta pipeline lane alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "freshness"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_freshness()
+    except Exception as e:
+        _state["errors"].append(
+            f"freshness lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["freshness"]
+    # the lane's headline is freshness correctness + bounded staleness, not
+    # a rate — leave the perf headline empty and gate on the lane's own
+    # pass criteria (mirrored by _check_freshness_regression)
+    _state["best_path"] = "freshness"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    gap = block.get("gap_drill") or {}
+    ok = (
+        block.get("bit_parity") == 0.0
+        and gap.get("recovered")
+        and gap.get("parity") == 0.0
+        and block.get("cutover_atomic")
+        and (block.get("lag_p99_ms") or 0.0) <= block.get(
+            "lag_ceiling_ms", 0.0)
+        and (block.get("serve_p99_ms") or 0.0) <= block.get(
+            "slo_p99_ms", 0.0)
+    )
+    return 0 if ok else 1
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -1948,7 +2019,7 @@ def main(argv=None):
     parser.add_argument(
         "--lane",
         choices=("full", "scaling", "chaos", "serve", "fleet", "tiered",
-                 "chaos-serve", "chaos-cluster"),
+                 "chaos-serve", "chaos-cluster", "freshness"),
         default="full",
         help="full = the headline bench (default); scaling = the scale-out "
              "lane alone (grouped-mesh 1-vs-N throughput per comm_dtype plus "
@@ -1968,7 +2039,11 @@ def main(argv=None):
              "tier bit-flip drills; valid on CPU); chaos-cluster = the "
              "cluster membership drill (simulated fleet under a kill/"
              "straggle/partition storm; exactly-once accounting + elastic "
-             "reassignment vs an unsupervised control; valid on CPU)",
+             "reassignment vs an unsupervised control; valid on CPU); "
+             "freshness = the trainer->fleet delta pipeline lane (hot-row "
+             "delta publish/apply under load: bit parity at the watermark, "
+             "lag p99, serve p99 while applying, forced-gap fallback; "
+             "valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -1988,6 +2063,8 @@ def main(argv=None):
         return run_chaos_serve_lane()
     if args.lane == "chaos-cluster":
         return run_chaos_cluster_lane()
+    if args.lane == "freshness":
+        return run_freshness_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

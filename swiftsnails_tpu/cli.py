@@ -13,6 +13,8 @@ Usage::
     python -m swiftsnails_tpu export -config train.conf -checkpoint ROOT -out vec.txt
     python -m swiftsnails_tpu serve  -config train.conf -checkpoint ROOT   # query REPL
     python -m swiftsnails_tpu serve  ... -replicas 4   # replica fleet behind the router
+    # in the serve REPL: `subscribe <dir>` follows the trainer's live
+    # hot-row delta log (freshness pipeline, docs/FRESHNESS.md)
     python -m swiftsnails_tpu models
     python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
@@ -125,6 +127,8 @@ def cmd_serve(argv: List[str]) -> int:
         health                       breaker / tier / version state
         add                          (fleet) add a replica to the ring
         drain <replica>              (fleet) drain + remove a replica
+        subscribe <dir>              follow a hot-row delta log (freshness)
+        freshness                    applied-seq watermark / lag / fallbacks
         quit
 
     ``-replicas N`` (or config ``serve_replicas``) > 1 serves through a
@@ -132,6 +136,15 @@ def cmd_serve(argv: List[str]) -> int:
     loaded planes behind the affinity/hedging router; the same REPL ops
     work (``Fleet`` mirrors the ``Servant`` query surface) plus elastic
     ``add``/``drain``, and ``health`` reports fleet-level liveness.
+
+    ``subscribe <dir>`` attaches a background
+    :class:`~swiftsnails_tpu.freshness.subscriber.DeltaSubscriber` polling
+    the trainer's delta log (docs/FRESHNESS.md): hot-row batches apply
+    behind the version-keyed cache with atomic cutover, and any gap /
+    publisher restart / CRC mismatch falls back to a full
+    ``reload_from_checkpoint`` of this checkpoint root. ``freshness``
+    reports the applied-seq watermark, lag, and fallback count (also
+    rolled into ``health``; fleets add per-replica versions).
     """
     import json
 
@@ -151,15 +164,17 @@ def cmd_serve(argv: List[str]) -> int:
     else:
         server_cm = Servant.from_checkpoint(
             root, cfg, mesh=_serve_mesh(cfg), ledger=ledger)
+    subscriber = None
     with server_cm as servant:
         if fleet_mode:
             banner = (f"serving fleet of {replicas} replicas "
                       f"(one request per line; pull/topk/score/stats/"
-                      "health/add/drain/quit)")
+                      "health/add/drain/subscribe/freshness/quit)")
         else:
             banner = (f"serving step {servant.step} tables "
                       f"{servant.stats()['tables']} (one request per line; "
-                      "pull/topk/score/stats/health/quit)")
+                      "pull/topk/score/stats/health/subscribe/freshness/"
+                      "quit)")
         print(banner, file=sys.stderr)
         for line in sys.stdin:
             toks = line.split()
@@ -189,6 +204,26 @@ def cmd_serve(argv: List[str]) -> int:
                     out = {"added": servant.add_replica()}
                 elif op == "drain" and fleet_mode:
                     out = {"drained": servant.drain(args[0])}
+                elif op == "subscribe":
+                    from swiftsnails_tpu.freshness.subscriber import (
+                        DeltaSubscriber)
+
+                    if subscriber is not None:
+                        subscriber.stop()
+                    subscriber = DeltaSubscriber(
+                        servant, args[0], config=cfg,
+                        checkpoint_root=root,
+                        max_lag_ms=cfg.get_float("freshness_max_lag_ms", 0.0),
+                        ledger=ledger)
+                    found = subscriber.subscribe()
+                    subscriber.start()
+                    servant.attach_freshness(subscriber)
+                    out = {"subscribed": args[0], "stream_open": found}
+                elif op == "freshness":
+                    if subscriber is None:
+                        out = {"error": "not subscribed (use: subscribe <dir>)"}
+                    else:
+                        out = subscriber.status()
                 else:
                     out = {"error": f"unknown op {op!r}"}
             except Overloaded as e:
@@ -198,6 +233,8 @@ def cmd_serve(argv: List[str]) -> int:
             except Exception as e:  # noqa: BLE001 — a REPL must not die
                 out = {"error": f"{type(e).__name__}: {e}"}
             print(json.dumps(out), flush=True)
+        if subscriber is not None:
+            subscriber.stop()
         print(json.dumps({"final_stats": servant.stats()}), flush=True)
     return 0
 

@@ -123,6 +123,13 @@ class Trainer:
         prewarm (seeded from corpus frequency ranks); ``None`` to skip."""
         return None
 
+    def table_geometry(self) -> Optional[Dict[str, Dict]]:
+        """``{table: {"layout", "group", "dim", "capacity"}}`` for the
+        freshness publisher — :meth:`tier_spec`'s layout map WITHOUT the
+        ``table_tier`` gate (resident runs publish too) plus the logical
+        row geometry. ``None`` (default) disables delta publishing."""
+        return None
+
     # -- hybrid-placement hook (placement: hybrid|auto; parallel/hybrid.py) --
 
     def placement_spec(self) -> Optional[Dict[str, Dict]]:
@@ -378,6 +385,18 @@ class TrainLoop:
 
         pm = PlacementManager(trainer, trainer.mesh)
         self.placement = pm if pm.active else None
+        # freshness_publish: N steps + freshness_dir -> hot-row delta
+        # publishing to serving subscribers (freshness/; docs/FRESHNESS.md).
+        # Off (the default) => None and the hot path pays one flag check.
+        self.freshness = None
+        if (cfg.get_int("freshness_publish", 0) > 0
+                and cfg.get_str("freshness_dir", "")):
+            from swiftsnails_tpu.freshness.publisher import TrainPublisher
+
+            fresh = TrainPublisher(
+                trainer, tier=self.tier, placement=self.placement,
+                ledger=self.ledger)
+            self.freshness = fresh if fresh.active else None
         # tier integrity sweep cadence (steps; 0 = only at heal requests).
         # Runs on the resilient path only — like chaos/guardrail, arming it
         # costs the plain hot path nothing.
@@ -475,6 +494,12 @@ class TrainLoop:
             # value-preserving; runs AFTER resume so a uniform-layout
             # checkpoint restores transparently into a hybrid run)
             state = self.placement.adopt(state)
+        fresh = self.freshness
+        if fresh is not None:
+            # one publisher incarnation per run, based on the resumed step;
+            # under table_tier: host this also installs the flush tee (so it
+            # must run AFTER tier.adopt built the tables)
+            fresh.open(base_step=step)
         depth = trainer.config.get_int("prefetch_batches", 2)
         cl = self.cluster
         if cl is not None:
@@ -531,6 +556,10 @@ class TrainLoop:
                         break
                     n_items = trainer.items_per_batch(batch)
                     self.profiler.on_step(step)
+                    if fresh is not None:
+                        # record touched rows BEFORE tier.prepare remaps the
+                        # batch ids to slot space (resident/transparent path)
+                        fresh.on_batch(batch, root_rng, step)
                     with step_annotation(trainer.name, step):
                         if tier is not None:
                             # fault the rows this step touches into the cache
@@ -561,6 +590,8 @@ class TrainLoop:
                         self.metrics.flush_window(step=step, **host)
                     if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
                         self.checkpoint_fn(state, step)
+                    if fresh is not None:
+                        fresh.maybe_publish(state, step)
                     if max_steps is not None and step >= max_steps:
                         break
             else:
@@ -579,6 +610,10 @@ class TrainLoop:
                         q_depth = batches.qsize()
                         reg.gauge("prefetch_queue_depth").set(q_depth)
                         tel.counter("prefetch_queue_depth", q_depth)
+                    if fresh is not None:
+                        # record touched rows BEFORE tier.prepare remaps the
+                        # batch ids to slot space (resident/transparent path)
+                        fresh.on_batch(batch, root_rng, step)
                     # step_span bridges to jax.profiler.StepTraceAnnotation,
                     # so a concurrent profile_dir capture lines device work
                     # up with these host spans by step number
@@ -626,6 +661,8 @@ class TrainLoop:
                     if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
                         with tel.span("checkpoint", step=step):
                             self.checkpoint_fn(state, step)
+                    if fresh is not None:
+                        fresh.maybe_publish(state, step)
                     if max_steps is not None and step >= max_steps:
                         break
         except BaseException as e:
@@ -667,6 +704,11 @@ class TrainLoop:
                 "step": step,
                 "error": "run preempted; drained with a final checkpoint",
             })
+        if self.freshness is not None:
+            # last delta before the caller materializes/abandons the state,
+            # so subscribers reach the final training watermark without
+            # waiting for a full checkpoint cycle
+            self.freshness.maybe_publish(state, step, force=True)
         if tier is not None:
             # end-of-run write-back: flush every dirty cache slot and hand
             # the caller the full-size master-backed state (same pytree type,

@@ -1,0 +1,400 @@
+"""Trainer-side delta publishing.
+
+Three layers:
+
+* :class:`DeltaPublisher` — owns one log directory: stamps each batch
+  with ``(publisher, seq, base_step, step, ts_ns)``, writes it
+  atomically, applies the ``freshness_log_mb`` retention, and emits
+  rate-limited ``delta`` ledger events.
+* :class:`TouchedRowCollector` — resident-path row source: per step it
+  asks the trainer's ``tier_plan`` for the exact master row ids the step
+  touches (hashing + the replicated negative draw included — the same
+  determinism contract the tiered store runs on), falling back to the
+  union of integer batch leaves when a trainer has no plan. Extra rows
+  are harmless: payloads carry absolute values, not diffs.
+* :class:`TrainPublisher` — the TrainLoop-owned facade wiring source to
+  sink: under ``table_tier: host`` it taps the tier's dirty-flush stream
+  (``TieredTable.delta_tap``) and gathers flushed units from the host
+  masters; on the resident (or transparent-tier) path it drains the
+  collector and gathers rows straight from the live state planes. Either
+  way the gathered values are normalized dense rows — bit-identical to
+  the serving engine's ``normalize_table`` lane selects.
+
+Publishing never blocks or kills training: every cadence publish is
+wrapped, failures land as ``freshness_gap`` ledger events and the stream
+simply misses a beat (subscribers see a late batch, not a torn one).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from swiftsnails_tpu.freshness.log import prune, write_base, write_batch
+
+_LEDGER_EVERY = 100  # rate limit: first publish + every 100th
+
+
+# ------------------------------------------------- normalized row gathers ---
+
+
+def gather_normalized_rows(plane, rows: np.ndarray, *, layout: str,
+                           dim: int) -> np.ndarray:
+    """Gather logical ``rows`` from a table plane in its trainer layout ->
+    ``[n, dim]`` f32, via the same exact lane selects the serving engine's
+    ``normalize_table`` uses (no arithmetic — bit-identical rows)."""
+    rows = np.asarray(rows, np.int64)
+    a = np.asarray(plane)
+    if layout == "dense":
+        return np.asarray(a[rows], np.float32)
+    if layout == "packed":
+        import jax.numpy as jnp
+
+        from swiftsnails_tpu.ops.rowdma import unpack_rows
+
+        tiles = jnp.asarray(a[rows])  # [n, S, 128], one row per tile
+        return np.asarray(unpack_rows(tiles, dim), np.float32)
+    if layout == "packed_small":
+        from swiftsnails_tpu.ops.rowdma import ROW_LANES
+        from swiftsnails_tpu.parallel.store import small_group
+
+        g = small_group(dim)
+        stride = ROW_LANES // g
+        sub0 = a[rows // g, 0, :]  # [n, 128]: sublane 0 = params
+        idx = ((rows % g) * stride)[:, None] + np.arange(dim)[None, :]
+        return np.take_along_axis(sub0, idx, axis=1).astype(
+            np.float32, copy=False)
+    raise ValueError(f"unknown table layout {layout!r}")
+
+
+def normalize_units(t_units: np.ndarray, units: np.ndarray, *, layout: str,
+                    dim: int, group: int,
+                    capacity: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Master-gathered units -> ``(row_ids, [n, dim] f32 values)``. A unit
+    is one logical row except ``packed_small`` (one tile = ``group`` rows:
+    a dirty tile publishes all its resident rows)."""
+    units = np.asarray(units, np.int64)
+    if layout in ("dense", "packed"):
+        vals = gather_normalized_rows(
+            t_units, np.arange(units.size), layout=layout, dim=dim)
+        return units, vals
+    if layout == "packed_small":
+        from swiftsnails_tpu.ops.rowdma import ROW_LANES
+
+        g = int(group)
+        stride = ROW_LANES // g
+        sub0 = np.asarray(t_units)[:, 0, :]  # [n, 128]
+        rows = (units[:, None] * g + np.arange(g)[None, :]).ravel()
+        rep = np.repeat(np.arange(units.size), g)
+        idx = ((rows % g) * stride)[:, None] + np.arange(dim)[None, :]
+        vals = np.take_along_axis(sub0[rep], idx, axis=1).astype(
+            np.float32, copy=False)
+        keep = rows < int(capacity)
+        return rows[keep], vals[keep]
+    raise ValueError(f"unknown table layout {layout!r}")
+
+
+# --------------------------------------------------------------- publisher ---
+
+
+class DeltaPublisher:
+    """One publisher incarnation over one delta-log directory."""
+
+    def __init__(self, dirpath: str, *, base_step: int,
+                 dtype: str = "float32", log_mb: float = 64.0,
+                 ledger=None):
+        if dtype not in ("float32", "int8"):
+            raise ValueError(
+                f"freshness_delta_dtype must be float32|int8, got {dtype!r}")
+        self.dir = os.path.abspath(dirpath)
+        self.dtype = dtype
+        self.log_mb = float(log_mb)
+        self.ledger = ledger
+        self.base_step = int(base_step)
+        self.id = uuid.uuid4().hex[:12]
+        self.seq = 0
+        self.published_batches = 0
+        self.published_rows = 0
+        self.published_bytes = 0
+        self.pruned = 0
+        # a new incarnation owns the directory: stale segments from a dead
+        # publisher use an unrelated numbering and must never be read as
+        # ours — drop them BEFORE the new base becomes visible
+        try:
+            from swiftsnails_tpu.freshness.log import list_seqs, seg_path
+            for s in list_seqs(self.dir):
+                try:
+                    os.remove(seg_path(self.dir, s))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        write_base(self.dir, {
+            "publisher": self.id,
+            "base_step": self.base_step,
+            "first_seq": 1,
+            "dtype": self.dtype,
+        })
+
+    def publish(self, updates: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                step: int) -> Optional[int]:
+        """Write one batch of ``{table: (row_ids, [n, dim] f32 values)}``
+        current as of trainer ``step``; returns the assigned seq (None when
+        every table came up empty — an empty batch is not published)."""
+        tables: Dict[str, Dict[str, np.ndarray]] = {}
+        total_rows = 0
+        for name, (rows, values) in updates.items():
+            rows = np.asarray(rows, np.int64).ravel()
+            if rows.size == 0:
+                continue
+            values = np.asarray(values, np.float32)
+            if self.dtype == "int8":
+                from swiftsnails_tpu.tiered.store import _np_quant_unit_rows
+
+                codes, scales = _np_quant_unit_rows(values)
+                tables[name] = {"rows": rows, "values": codes,
+                                "scales": scales}
+            else:
+                tables[name] = {"rows": rows, "values": values}
+            total_rows += int(rows.size)
+        if not tables:
+            return None
+        self.seq += 1
+        header = {
+            "seq": self.seq,
+            "publisher": self.id,
+            "base_step": self.base_step,
+            "step": int(step),
+            "ts_ns": time.time_ns(),
+            "dtype": self.dtype,
+        }
+        path = write_batch(self.dir, header, tables)
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            nbytes = 0
+        self.published_batches += 1
+        self.published_rows += total_rows
+        self.published_bytes += nbytes
+        self.pruned += prune(self.dir, int(self.log_mb * (1 << 20)))
+        if self.ledger is not None and (
+                self.published_batches == 1
+                or self.published_batches % _LEDGER_EVERY == 0):
+            try:
+                self.ledger.append("delta", {
+                    "source": "freshness",
+                    "publisher": self.id,
+                    "seq": self.seq,
+                    "step": int(step),
+                    "rows": total_rows,
+                    "bytes": nbytes,
+                    "dtype": self.dtype,
+                    "published_batches": self.published_batches,
+                })
+            except Exception:
+                pass  # record-keeping never blocks the publish path
+        return self.seq
+
+    def stats(self) -> Dict:
+        return {
+            "publisher": self.id,
+            "seq": self.seq,
+            "base_step": self.base_step,
+            "dtype": self.dtype,
+            "published_batches": self.published_batches,
+            "published_rows": self.published_rows,
+            "published_bytes": self.published_bytes,
+            "pruned": self.pruned,
+        }
+
+
+# --------------------------------------------------------------- collector ---
+
+
+class TouchedRowCollector:
+    """Union of master row ids touched since the last drain (resident path).
+
+    Primary source: the trainer's ``tier_plan`` (exact ids, hashing and the
+    deterministic negative draw included). Fallback when a trainer has no
+    plan: every integer batch leaf, attributed to every table and masked to
+    capacity at drain — an over-approximation, harmless for absolute-value
+    payloads.
+    """
+
+    _COMPACT_EVERY = 64  # chunks per table before an in-place unique
+
+    def __init__(self, trainer):
+        self.trainer = trainer
+        self._plan_ok = True
+        self._acc: Dict[Optional[str], List[np.ndarray]] = {}
+
+    def observe(self, batch: Dict, root_rng, step: int) -> None:
+        ids = None
+        if self._plan_ok:
+            try:
+                ids, _aug, _remap = self.trainer.tier_plan(
+                    batch, root_rng, np.uint32(step))
+            except Exception:
+                self._plan_ok = False
+        if ids is None:
+            leaves = [
+                np.asarray(v).ravel() for v in batch.values()
+                if np.issubdtype(np.asarray(v).dtype, np.integer)
+            ]
+            ids = {None: np.concatenate(leaves) if leaves
+                   else np.zeros(0, np.int64)}
+        for name, rows in ids.items():
+            chunks = self._acc.setdefault(name, [])
+            chunks.append(np.asarray(rows, np.int64).ravel())
+            if len(chunks) > self._COMPACT_EVERY:
+                self._acc[name] = [np.unique(np.concatenate(chunks))]
+
+    def drain(self, geometry: Dict[str, Dict]) -> Dict[str, np.ndarray]:
+        """Pending ids -> ``{table: unique in-capacity row ids}``; resets."""
+        acc, self._acc = self._acc, {}
+        out: Dict[str, np.ndarray] = {}
+        for name, g in geometry.items():
+            chunks = list(acc.get(name, ()))
+            chunks.extend(acc.get(None, ()))  # fallback leaves: every table
+            if not chunks:
+                continue
+            rows = np.unique(np.concatenate(chunks))
+            rows = rows[(rows >= 0) & (rows < int(g["capacity"]))]
+            if rows.size:
+                out[name] = rows
+        return out
+
+
+# ---------------------------------------------------------- loop-side hook ---
+
+
+class TrainPublisher:
+    """The TrainLoop's freshness hook: decide the row source once, then
+    ``on_batch`` each step and ``maybe_publish`` at the configured cadence
+    (``freshness_publish`` steps; a final forced publish at end of run)."""
+
+    def __init__(self, trainer, *, tier=None, placement=None, ledger=None):
+        cfg = trainer.config
+        self.trainer = trainer
+        self.tier = tier
+        self.ledger = ledger
+        self.period = cfg.get_int("freshness_publish", 0)
+        self.dir = cfg.get_str("freshness_dir", "")
+        self.dtype = cfg.get_str("freshness_delta_dtype", "float32")
+        self.log_mb = cfg.get_float("freshness_log_mb", 64.0)
+        self.geometry = trainer.table_geometry()
+        self.active = bool(self.period > 0 and self.dir and self.geometry)
+        if self.active and placement is not None:
+            # hybrid head/tail planes aren't in master row layout mid-run;
+            # publishing would ship rows from the wrong id space
+            import sys
+
+            print("freshness: publishing disabled under hybrid placement "
+                  "(planes leave master layout mid-run)", file=sys.stderr)
+            self.active = False
+        self.pub: Optional[DeltaPublisher] = None
+        self.collector: Optional[TouchedRowCollector] = None
+        self._tap: Dict[str, List[np.ndarray]] = {}
+        self._tap_lock = threading.Lock()
+        self.errors = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self, base_step: int) -> None:
+        """Start an incarnation: called once per run, after tier adopt (so
+        transparent pass-through mode is known) with the resume step."""
+        if not self.active:
+            return
+        self.pub = DeltaPublisher(
+            self.dir, base_step=base_step, dtype=self.dtype,
+            log_mb=self.log_mb, ledger=self.ledger)
+        if self.tier is not None and not self.tier.all_transparent:
+            # dirty-flush tee: every landed write-back records its units
+            for name, tt in self.tier.tables.items():
+                tt.delta_tap = self._on_flush
+        else:
+            # resident (or transparent-tier: identity slot map, raw-id
+            # batches, live full planes) — collect touched rows per step
+            self.collector = TouchedRowCollector(self.trainer)
+
+    # -- per-step hooks ------------------------------------------------------
+
+    def on_batch(self, batch: Dict, root_rng, step: int) -> None:
+        """Observe BEFORE ``tier.prepare`` remaps ids to slot space."""
+        if self.collector is not None and self.pub is not None:
+            try:
+                self.collector.observe(batch, root_rng, step)
+            except Exception:
+                self.errors += 1
+
+    def _on_flush(self, name: str, units: np.ndarray) -> None:
+        with self._tap_lock:
+            self._tap.setdefault(name, []).append(
+                np.asarray(units, np.int64).copy())
+
+    def maybe_publish(self, state, step: int, force: bool = False) -> None:
+        if self.pub is None:
+            return
+        if not force and (self.period <= 0 or step == 0
+                          or step % self.period != 0):
+            return
+        try:
+            self._publish(state, step)
+        except Exception as e:  # publishing must never kill training
+            self.errors += 1
+            if self.ledger is not None:
+                try:
+                    self.ledger.append("freshness_gap", {
+                        "source": "publisher",
+                        "reason": "publish_error",
+                        "step": int(step),
+                        "error": f"{type(e).__name__}: {e}",
+                    })
+                except Exception:
+                    pass
+
+    # -- the publish itself --------------------------------------------------
+
+    def _publish(self, state, step: int) -> None:
+        updates: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        if self.collector is not None:
+            pending = self.collector.drain(self.geometry)
+            if pending:
+                tabs = self.trainer.tier_tables(state)
+                for name, rows in pending.items():
+                    g = self.geometry[name]
+                    vals = gather_normalized_rows(
+                        tabs[name].table, rows,
+                        layout=g["layout"], dim=int(g["dim"]))
+                    updates[name] = (rows, vals)
+        else:
+            # flush first so the masters hold the exact step-`step` rows —
+            # the flush tee below records every landed unit
+            self.tier.flush_dirty(state)
+            with self._tap_lock:
+                tapped, self._tap = self._tap, {}
+            for name, chunks in tapped.items():
+                tt = self.tier.tables.get(name)
+                g = self.geometry.get(name)
+                if tt is None or g is None or not chunks:
+                    continue
+                units = np.unique(np.concatenate(chunks))
+                t_units, _slots = tt.master.gather(units)
+                rows, vals = normalize_units(
+                    np.asarray(t_units), units, layout=g["layout"],
+                    dim=int(g["dim"]), group=int(g.get("group", 1)),
+                    capacity=int(g["capacity"]))
+                updates[name] = (rows, vals)
+        self.pub.publish(updates, step)
+
+    def stats(self) -> Dict:
+        out = {"active": self.active, "period": self.period,
+               "errors": self.errors}
+        if self.pub is not None:
+            out.update(self.pub.stats())
+        return out

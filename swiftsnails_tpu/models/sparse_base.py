@@ -436,6 +436,17 @@ class SparseCTRTrainer(Trainer):
                               "group": small_group(self.table_dim)}}
         return {"table": {"layout": "dense", "group": 1}}
 
+    def table_geometry(self):
+        if self.packed:
+            from swiftsnails_tpu.parallel.store import small_group
+
+            group = small_group(self.table_dim)
+            layout = "packed_small"
+        else:
+            group, layout = 1, "dense"
+        return {"table": {"layout": layout, "group": group,
+                          "dim": self.table_dim, "capacity": self.capacity}}
+
     def tier_tables(self, state: CTRState):
         return {"table": state.table}
 

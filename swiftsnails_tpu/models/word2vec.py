@@ -1274,6 +1274,12 @@ class Word2VecTrainer(Trainer):
             "out_table": {"layout": layout, "group": 1},
         }
 
+    def table_geometry(self):
+        layout = "packed" if self.packed else "dense"
+        geo = {"layout": layout, "group": 1, "dim": self.dim,
+               "capacity": self.capacity}
+        return {"in_table": dict(geo), "out_table": dict(geo)}
+
     def tier_tables(self, state: W2VState):
         return {"in_table": state.in_table, "out_table": state.out_table}
 

@@ -382,6 +382,9 @@ class Servant:
         # exactly as a sick device/storage read would (chaos-serve lane).
         self.degraded_enabled = bool(degraded)
         self.fault_hook = None
+        # freshness: an attached DeltaSubscriber surfaces its watermark/lag
+        # through health() (cli `freshness` op; Fleet rolls replicas up)
+        self._freshness = None
         self._dispatch_seq = {"pull": 0, "topk": 0, "score": 0}
         self.breakers: Dict[str, CircuitBreaker] = {}
         if int(breaker_threshold) > 0:
@@ -549,9 +552,11 @@ class Servant:
         )
 
     def reload(self, tables: Dict[str, Any], manifest: Optional[Dict] = None,
-               dense=None) -> int:
+               dense=None, *, version: Optional[int] = None) -> int:
         """Swap in new tables; bumps the version so every cached row of the
-        old tables misses (stale rows can never be served)."""
+        old tables misses (stale rows can never be served). ``version`` is
+        the fleet-epoch override: replicas sharing one logical swap all cut
+        over to the SAME number instead of bumping independently."""
         with self._lock:
             if self.tier_budget_mb > 0:
                 # new masters + fresh caches/slot maps: a stale slot mapping
@@ -567,7 +572,89 @@ class Servant:
             if manifest is not None:
                 self.manifest = manifest
                 self.step = int(manifest.get("step", self.step) or 0)
-            self.version += 1
+            self.version = int(version) if version is not None \
+                else self.version + 1
+            return self.version
+
+    # -- freshness delta apply (freshness/; docs/FRESHNESS.md) ---------------
+
+    def prepare_rows(self, updates: Dict[str, Any]) -> Dict[str, Any]:
+        """Build the post-delta table planes OFF the serving path (pure —
+        nothing is installed). ``updates``: ``{table: (row_ids, [n, dim]
+        values)}`` of absolute normalized rows. Split from
+        :meth:`install_tables` so a fleet computes the new planes once and
+        installs the SAME arrays into every replica at one shared epoch."""
+        out: Dict[str, Any] = {}
+        for name, (ids, vals) in updates.items():
+            if name not in self._tables:
+                continue  # a delta stream may carry tables we don't serve
+            tab = self._tables[name]
+            ids = np.asarray(ids)
+            vals = np.asarray(vals)
+            # pad to the next power of two by repeating the last row (same
+            # id + same value scatters are no-ops), so a stream of
+            # arbitrary-sized delta batches compiles O(log n) scatter
+            # shapes instead of one per distinct batch size
+            n = int(ids.shape[0])
+            m = 1 << max(n - 1, 0).bit_length()
+            if m > n:
+                ids = np.concatenate([ids, np.repeat(ids[-1:], m - n)])
+                vals = np.concatenate(
+                    [vals, np.repeat(vals[-1:], m - n, axis=0)])
+            ids = jnp.asarray(ids, jnp.int32)
+            vals = jnp.asarray(vals, tab.dtype)
+            out[name] = tab.at[ids].set(vals)
+        return out
+
+    def install_tables(self, new_tables: Dict[str, Any], *,
+                       version: Optional[int] = None,
+                       step: Optional[int] = None) -> int:
+        """Atomic cutover of (some) resident planes: the table dict is
+        replaced wholesale under the lock, so a concurrent request sees the
+        whole old set or the whole new set — never a torn batch. The version
+        bump invalidates every hot-row cache entry of the old planes."""
+        with self._lock:
+            self._tables = {**self._tables, **new_tables}
+            if step is not None:
+                self.step = max(self.step, int(step))
+            self.version = int(version) if version is not None \
+                else self.version + 1
+            return self.version
+
+    def apply_rows(self, updates: Dict[str, Any], *,
+                   version: Optional[int] = None,
+                   step: Optional[int] = None) -> int:
+        """Apply one delta batch of absolute rows with an atomic version
+        cutover; returns the new version. Resident tables go through the
+        pure :meth:`prepare_rows` + locked :meth:`install_tables` pair;
+        tiered tables scatter into the host masters (through
+        ``HostMaster.scatter``, so the integrity digests stay true), bump
+        the touched units' write-back generation, and invalidate their
+        resident cache slots so the next pull refaults the fresh rows."""
+        if self.tier_budget_mb <= 0:
+            return self.install_tables(self.prepare_rows(updates),
+                                       version=version, step=step)
+        with self._lock, self._tier_lock:
+            for name, (ids, vals) in updates.items():
+                if name not in self.tier:
+                    continue  # delta table this servant doesn't serve
+                tt = self.tier[name]
+                ids = np.asarray(ids, np.int64)
+                vals = np.asarray(vals, tt.master.table_dtype)
+                # serving masters are dense group-1 planes: unit == row
+                tt.master.scatter(ids, vals, {})
+                self._tables[name][ids] = vals
+                tt.master_ver[ids] += 1
+                res = ids[tt.slot_of[ids] >= 0]
+                if res.size:
+                    slots = tt.slot_of[res]
+                    tt.unit_of[slots] = -1
+                    tt.ref[slots] = 0
+                    tt.slot_of[res] = -1
+            if step is not None:
+                self.step = max(self.step, int(step))
+            self.version = int(version) if version is not None \
+                else self.version + 1
             return self.version
 
     def reload_from_checkpoint(self, root: str, config, *,
@@ -1064,7 +1151,18 @@ class Servant:
                        "resident": int((tt.unit_of >= 0).sum())}
                 for name, tt in self.tier.items()
             }
+        if self._freshness is not None:
+            try:
+                out["freshness"] = self._freshness.status()
+            except Exception:
+                pass  # introspection never blocks the health probe
         return out
+
+    def attach_freshness(self, subscriber) -> None:
+        """Surface a :class:`~swiftsnails_tpu.freshness.subscriber.
+        DeltaSubscriber`'s watermark/lag/fallback state through
+        :meth:`health`."""
+        self._freshness = subscriber
 
 
 def _int_list(raw: str, default: Sequence[int]) -> Tuple[int, ...]:

@@ -59,6 +59,7 @@ from swiftsnails_tpu.serving.engine import (
     DEFAULT_BREAKER_THRESHOLD,
     Overloaded,
     Servant,
+    _normalize_state_tables,
 )
 from swiftsnails_tpu.serving.router import (
     DEFAULT_HEDGE_BUDGET_PCT,
@@ -207,6 +208,7 @@ class Fleet:
         self._gov = HedgeGovernor(hedge_budget_pct)
         self._p95 = {k: EwmaQuantile(initial=hedge_p95_ms) for k in _KERNELS}
         self._hedge_events = 0
+        self._freshness = None  # an attached DeltaSubscriber (health rollup)
         self._pool = ThreadPoolExecutor(
             max_workers=max(int(max_inflight), 2 * replicas + 2),
             thread_name_prefix="ssn-fleet",
@@ -360,6 +362,103 @@ class Fleet:
         if ring_spill is not None:
             self.ring_spill = float(ring_spill)
         return self
+
+    # -- fleet-wide epoch cutover (freshness/; docs/FRESHNESS.md) -----------
+    #
+    # Shared-plane swaps (delta apply, live reload) must land every replica
+    # on the SAME cache version: independent per-replica bumps would let two
+    # replicas disagree mid-cutover on which planes a version number means.
+    # One epoch — strictly above every replica's current version — is chosen
+    # up front and installed everywhere.
+
+    @property
+    def step(self) -> int:
+        """Newest checkpoint/watermark step any replica serves."""
+        with self._lock:
+            return max((r.servant.step for r in self._replicas.values()),
+                       default=0)
+
+    @property
+    def version(self) -> int:
+        """The fleet cache epoch (max over replicas; equal everywhere
+        outside the instants of a cutover)."""
+        with self._lock:
+            return max((r.servant.version for r in self._replicas.values()),
+                       default=0)
+
+    def _next_epoch(self) -> int:
+        with self._lock:
+            return max((r.servant.version for r in self._replicas.values()),
+                       default=0) + 1
+
+    def apply_rows(self, updates: Dict[str, Any], *,
+                   step: Optional[int] = None) -> int:
+        """Apply one freshness delta batch fleet-wide at a single epoch.
+
+        Resident fleets share one set of planes, so the post-delta arrays
+        are computed ONCE (``prepare_rows`` on the first replica) and the
+        same arrays install into every replica — no replica ever serves a
+        torn batch, and every cache cuts over to the same version. Tiered
+        replicas own separate host masters and apply individually, still at
+        the shared epoch."""
+        epoch = self._next_epoch()
+        reps = self.replicas()
+        if not reps:
+            raise Unavailable("fleet: no active replicas")
+        first = reps[0].servant
+        if first.tier_budget_mb > 0:
+            for rep in reps:
+                rep.servant.apply_rows(updates, version=epoch, step=step)
+        else:
+            new_tables = first.prepare_rows(updates)
+            for rep in reps:
+                rep.servant.install_tables(new_tables, version=epoch,
+                                           step=step)
+        return epoch
+
+    def reload(self, tables: Dict[str, Any], manifest: Optional[Dict] = None,
+               dense=None) -> int:
+        """Swap new planes into every replica at one shared epoch."""
+        epoch = self._next_epoch()
+        for rep in self.replicas():
+            rep.servant.reload(tables, manifest=manifest, dense=dense,
+                               version=epoch)
+        return epoch
+
+    def reload_from_checkpoint(self, root: str, config, *,
+                               step: Optional[int] = None,
+                               retry=None) -> int:
+        """The fleet twin of the Servant's shadow reload: load + verify the
+        checkpoint ONCE off the serving path, then cut every replica over
+        to the same planes at one epoch (mixed versions can never serve)."""
+        from swiftsnails_tpu.framework.checkpoint import load_tables
+
+        reps = self.replicas()
+        if not reps:
+            raise Unavailable("fleet: no active replicas")
+        first = reps[0].servant
+        try:
+            state, manifest = load_tables(
+                root, step=step, verify=True, retry=retry)
+            tables, dense, _ = _normalize_state_tables(
+                state, config, first.scorer, first.mesh)
+        except Exception as e:
+            self.registry.counter("fleet.reload_rejected").inc()
+            self._ledger_event("cache_error", {
+                "probe": "fleet_reload",
+                "root": root,
+                "step": step,
+                "kept_version": self.version,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            raise
+        return self.reload(tables, manifest=manifest, dense=dense)
+
+    def attach_freshness(self, subscriber) -> None:
+        """Roll a :class:`~swiftsnails_tpu.freshness.subscriber.
+        DeltaSubscriber`'s watermark/lag/fallback state into
+        :meth:`health`."""
+        self._freshness = subscriber
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)
@@ -614,6 +713,8 @@ class Fleet:
                 "state": rep.state,
                 "status": rep.servant.health()["status"]
                 if rep.state != CLOSED else "closed",
+                "version": rep.servant.version,
+                "step": rep.servant.step,
             }
         active = [v for v in statuses.values() if v["state"] == ACTIVE]
         if not active:
@@ -622,9 +723,18 @@ class Fleet:
             status = "ok"
         else:
             status = "degraded"
-        return {
+        out = {
             "status": status,
             "replicas": statuses,
             "active": len(active),
             "hedge": self._gov.snapshot(),
         }
+        if self._freshness is not None:
+            try:
+                fr = self._freshness.status()
+                fr["replica_versions"] = {
+                    rid: v["version"] for rid, v in statuses.items()}
+                out["freshness"] = fr
+            except Exception:
+                pass  # introspection never blocks the health probe
+        return out

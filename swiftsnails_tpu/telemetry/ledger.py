@@ -589,7 +589,7 @@ def render_report(ledger: Ledger) -> str:
 # for context — `ledger-report --failures`
 FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
                  "retry_exhausted", "breaker", "degraded", "membership",
-                 "hedge", "drain")
+                 "hedge", "drain", "freshness_gap")
 
 
 def _failure_line(r: Dict) -> str:
@@ -669,6 +669,26 @@ def _failure_line(r: Dict) -> str:
             f"  {ts}  DRAIN    {r.get('replica')} start "
             f"inflight={r.get('inflight')} "
             f"remaining={r.get('remaining_replicas')}"
+        )
+    if kind == "freshness_gap":
+        # delta-subscriber breakpoints (freshness/subscriber.py): phase
+        # "detect" is the gap/crc/restart trigger; phase "fallback" is the
+        # full-reload recovery that follows it
+        if r.get("phase") == "fallback":
+            return (
+                f"  {ts}  FRESHNESS-FALLBACK reason={r.get('reason')} "
+                f"recovered={r.get('recovered')} "
+                f"version={r.get('version')} "
+                f"reseq={r.get('resubscribed_seq')} "
+                f"floor_step={r.get('floor_step')}"
+            )
+        return (
+            f"  {ts}  DELTA-GAP  source={r.get('source')} "
+            f"reason={r.get('reason')} "
+            f"next_seq={r.get('next_seq')} "
+            f"applied_seq={r.get('applied_seq')} "
+            f"fallbacks={r.get('fallbacks')}"
+            + (f"  {str(r.get('error', ''))[:70]}" if r.get("error") else "")
         )
     if kind == "membership":
         # the cluster supervisor's lifecycle timeline (cluster/supervisor.py)
@@ -750,6 +770,17 @@ def render_failures(ledger: Ledger) -> str:
                 f"reassigned={c.get('reassignments')} "
                 f"loss_parity={c.get('loss_parity')}"
             )
+        elif kind == "bench" and isinstance(r.get("payload"), dict) \
+                and isinstance(r["payload"].get("freshness"), dict):
+            c = r["payload"]["freshness"]
+            gap = c.get("gap_drill") or {}
+            lines.append(
+                f"  {r.get('ts', '?')}  bench    freshness lane: "
+                f"bit_parity={c.get('bit_parity')} "
+                f"lag_p99={c.get('lag_p99_ms')}ms "
+                f"serve_p99={c.get('serve_p99_ms')}ms "
+                f"gap_recovered={gap.get('recovered')}"
+            )
     if shown == 0:
         lines.append("  (no failure events recorded)")
     return "\n".join(lines)
@@ -806,7 +837,11 @@ def check_regression(
         q_rc, q_msg = _check_quantized_wire_regression(ledger)
         if q_msg:
             msg = f"{msg}\n{q_msg}"
-        return max(2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
+        n_rc, n_msg = _check_freshness_regression(ledger)
+        if n_msg:
+            msg = f"{msg}\n{n_msg}"
+        return max(
+            2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -840,7 +875,11 @@ def check_regression(
             q_rc, q_msg = _check_quantized_wire_regression(ledger)
             if q_msg:
                 msg = f"{msg}\n{q_msg}"
-            return max(0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
+            n_rc, n_msg = _check_freshness_regression(ledger)
+            if n_msg:
+                msg = f"{msg}\n{n_msg}"
+            return max(
+                0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -881,7 +920,11 @@ def check_regression(
     q_rc, q_msg = _check_quantized_wire_regression(ledger)
     if q_msg:
         msg = f"{msg}\n{q_msg}"
-    return max(rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc), msg
+    n_rc, n_msg = _check_freshness_regression(ledger)
+    if n_msg:
+        msg = f"{msg}\n{n_msg}"
+    return max(
+        rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -1027,6 +1070,56 @@ def _check_quantized_wire_regression(
     return 0, (
         f"int4-wire ok: exchange bytes {red:.2f}x below f32 "
         f"(floor {_INT4_PAYLOAD_FLOOR:.1f}x), loss parity {parity}"
+    )
+
+
+def _check_freshness_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the freshness lane: the newest bench record carrying a
+    ``freshness`` block must show bit-identical delta-applied rows vs the
+    same-watermark checkpoint (correctness — any platform gates), a
+    recovered gap drill, delta lag p99 under the lane's ceiling, and serve
+    p99 within the SLO while deltas were applying. No freshness history
+    gates nothing."""
+    with_fresh = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("freshness"), dict)
+    ]
+    if not with_fresh:
+        return 0, None
+    f = with_fresh[-1]["payload"]["freshness"]
+    problems = []
+    parity = f.get("bit_parity")
+    if not (isinstance(parity, (int, float)) and parity == 0.0):
+        problems.append(
+            f"delta-applied rows are not bit-identical to the "
+            f"same-watermark checkpoint (parity={parity})")
+    gap = f.get("gap_drill") or {}
+    if not gap.get("recovered"):
+        problems.append("gap drill did not recover via full reload")
+    gap_parity = gap.get("parity")
+    if isinstance(gap_parity, (int, float)) and gap_parity != 0.0:
+        problems.append(f"post-fallback parity {gap_parity} != 0.0")
+    lag = f.get("lag_p99_ms")
+    ceiling = f.get("lag_ceiling_ms")
+    if (isinstance(lag, (int, float)) and isinstance(ceiling, (int, float))
+            and ceiling > 0 and lag > ceiling):
+        problems.append(
+            f"freshness lag p99 {lag:.1f}ms above the "
+            f"{ceiling:.0f}ms ceiling")
+    p99 = f.get("serve_p99_ms")
+    slo = f.get("slo_p99_ms")
+    if (isinstance(p99, (int, float)) and isinstance(slo, (int, float))
+            and slo > 0 and p99 > slo):
+        problems.append(
+            f"serve p99 {p99:.1f}ms above the {slo:.0f}ms SLO while "
+            f"applying deltas")
+    if problems:
+        return 1, "freshness REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"freshness ok: bit parity {parity}, lag p99 "
+        f"{_fmt_num(lag)}ms (ceiling {_fmt_num(ceiling)}ms), serve p99 "
+        f"{_fmt_num(p99)}ms (SLO {_fmt_num(slo)}ms), gap drill recovered"
     )
 
 

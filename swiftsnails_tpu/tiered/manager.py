@@ -282,6 +282,17 @@ class TierManager:
         else:
             self.flusher.drain()
 
+    def flush_dirty(self, state) -> None:
+        """Freshness-publish barrier: land every queued async flush and
+        write every dirty slot back, leaving the caches mapped. The cheap
+        sibling of :meth:`master_state` — no full-state materialization;
+        after it the masters hold the exact resident-table content (and the
+        flush tee has recorded every landed unit)."""
+        self._drain()
+        tabs = self.trainer.tier_tables(state)
+        for name, tt in self.tables.items():
+            self.retry.call(tt.flush, tabs[name], op=f"tier_flush:{name}")
+
     def master_state(self, state):
         """Flush every dirty slot, then return the full-size master-backed
         state (same pytree type/shapes/dtypes; NumPy leaves). The flush

@@ -633,6 +633,10 @@ class TieredTable:
         self.name = name or "table"
         self.stats = stats if stats is not None else TierStats()
         self.read_only = read_only
+        # freshness tee: fn(name, units) invoked after every landed master
+        # write-back (the dirty-flush stream IS the delta-publish signal);
+        # None = no subscriber, zero cost
+        self.delta_tap = None
         budget = max(int(budget_units), 1)
         if mesh is not None:
             from swiftsnails_tpu.parallel.mesh import MODEL_AXIS
@@ -1028,6 +1032,11 @@ class TieredTable:
         self.master_ver[units] += 1
         if self._pending is not None:
             self._pending[units] = 0
+        if self.delta_tap is not None:
+            try:
+                self.delta_tap(self.name, units)
+            except Exception:
+                pass  # the freshness tee never blocks the write-back
         self.stats.d2h_bytes += t_rows.nbytes + sum(
             v.nbytes for v in s_rows.values())
         self.stats.flushes += 1

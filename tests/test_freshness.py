@@ -1,0 +1,365 @@
+"""Freshness pipeline: delta-log wire format, publisher incarnations,
+idempotent/out-of-order-safe subscription, gap->fallback recovery,
+quantized delta parity, fleet-wide cutover atomicity, and the freshness
+ledger/CI surfaces.
+
+The delta pipeline's correctness bars (ISSUE 14): a batch must round-trip
+bit-identically (f32 wire) and any bit flip must be rejected by the CRC;
+re-delivering an applied batch must be a counted no-op (absolute values +
+``(table, row, seq)`` keying); out-of-order delivery within the reorder
+window must buffer and drain in sequence order; a sequence gap must fall
+back to a full checkpoint reload and resume PAST the dead batch (never
+loop on it); int8 deltas must dequantize to exactly what a flush +
+requantized host master serves; a fleet-wide apply must land every
+replica on one shared version; and the DELTA-GAP / FRESHNESS-FALLBACK
+failure lines plus the ``check_regression`` freshness gate must fire.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.freshness.log import (
+    DeltaCorrupt,
+    list_seqs,
+    prune,
+    read_base,
+    read_batch,
+    seg_path,
+    write_batch,
+)
+from swiftsnails_tpu.freshness.publisher import DeltaPublisher
+from swiftsnails_tpu.freshness.subscriber import DeltaSubscriber
+from swiftsnails_tpu.serving import Servant
+from swiftsnails_tpu.serving.fleet import Fleet
+from swiftsnails_tpu.telemetry.ledger import (
+    Ledger,
+    check_regression,
+    render_failures,
+)
+from swiftsnails_tpu.tiered.store import (
+    _np_dequant_unit_rows,
+    _np_quant_unit_rows,
+)
+
+DIM = 8
+CAP = 64
+
+
+def _vals(rows, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((len(rows), DIM)).astype(np.float32)
+
+
+class FakeTarget:
+    """Minimal serving target: the apply_rows / reload_from_checkpoint /
+    step / version surface the subscriber drives."""
+
+    def __init__(self, cap=CAP, dim=DIM):
+        self.tables = {"t": np.zeros((cap, dim), np.float32)}
+        self.step = 0
+        self.version = 0
+        self.applies = 0
+        self.reloads = 0
+
+    def apply_rows(self, updates, *, version=None, step=None):
+        for name, (rows, vals) in updates.items():
+            self.tables[name][np.asarray(rows, np.int64)] = np.asarray(
+                vals, np.float32)
+        if step is not None:
+            self.step = max(self.step, int(step))
+        self.version = int(version) if version is not None \
+            else self.version + 1
+        self.applies += 1
+        return self.version
+
+    def reload_from_checkpoint(self, root, config, **kw):
+        self.reloads += 1
+        self.version += 1
+        return self.version
+
+
+# --------------------------------------------------------- wire format ----
+
+
+def test_batch_round_trip_bit_identical(tmp_path):
+    d = str(tmp_path)
+    rows = np.array([3, 0, 17, CAP - 1], np.int64)
+    vals = _vals(rows, 1)
+    header = {"seq": 1, "publisher": "p0", "base_step": 4, "step": 5,
+              "ts_ns": 123, "dtype": "float32"}
+    write_batch(d, header, {"t": {"rows": rows, "values": vals}})
+    got_header, got_tables = read_batch(seg_path(d, 1))
+    assert got_header["publisher"] == "p0"
+    assert (got_header["seq"], got_header["step"]) == (1, 5)
+    np.testing.assert_array_equal(got_tables["t"]["rows"], rows)
+    # f32 wire: the served rows must be bit-identical to the published ones
+    np.testing.assert_array_equal(got_tables["t"]["values"], vals)
+
+
+def test_crc_rejects_bitflip_and_truncation(tmp_path):
+    d = str(tmp_path)
+    rows = np.arange(8, dtype=np.int64)
+    write_batch(d, {"seq": 1, "publisher": "p0", "dtype": "float32"},
+                {"t": {"rows": rows, "values": _vals(rows, 2)}})
+    path = seg_path(d, 1)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(DeltaCorrupt):
+        read_batch(path)
+    open(path, "wb").write(bytes(blob[:10]))
+    with pytest.raises(DeltaCorrupt):
+        read_batch(path)
+
+
+def test_prune_deletes_oldest_first_and_keeps_newest(tmp_path):
+    d = str(tmp_path)
+    rows = np.arange(16, dtype=np.int64)
+    for seq in range(1, 6):
+        write_batch(d, {"seq": seq, "publisher": "p0", "dtype": "float32"},
+                    {"t": {"rows": rows, "values": _vals(rows, seq)}})
+    one = os.path.getsize(seg_path(d, 1))
+    deleted = prune(d, max_bytes=2 * one + one // 2)
+    assert deleted == 3
+    assert list_seqs(d) == [4, 5]
+    # even an impossible budget never deletes the newest batch
+    prune(d, max_bytes=0)
+    assert list_seqs(d) == [5]
+
+
+# ---------------------------------------------------- publisher restart ----
+
+
+def test_new_publisher_incarnation_owns_the_directory(tmp_path):
+    d = str(tmp_path / "log")
+    rows = np.arange(4, dtype=np.int64)
+    a = DeltaPublisher(d, base_step=1)
+    for step in (2, 3, 4):
+        a.publish({"t": (rows, _vals(rows, step))}, step)
+    assert list_seqs(d) == [1, 2, 3]
+    # a restart renumbers from 1: the dead incarnation's segments must be
+    # gone BEFORE the new base is visible, or a subscriber could read them
+    b = DeltaPublisher(d, base_step=4)
+    assert b.id != a.id
+    assert list_seqs(d) == []
+    assert read_base(d)["publisher"] == b.id
+    b.publish({"t": (rows, _vals(rows, 9))}, 5)
+    assert list_seqs(d) == [1]
+
+
+# ----------------------------------------------------------- subscriber ----
+
+
+def test_duplicate_redelivery_is_a_counted_noop(tmp_path):
+    d = str(tmp_path / "log")
+    pub = DeltaPublisher(d, base_step=0)
+    rows = np.array([2, 7, 11], np.int64)
+    vals = _vals(rows, 3)
+    pub.publish({"t": (rows, vals)}, 1)
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d)
+    assert sub.poll() == 1
+    np.testing.assert_array_equal(tgt.tables["t"][rows], vals)
+    snapshot = tgt.tables["t"].copy()
+    # re-deliver the exact batch the stream already applied
+    header, tables = read_batch(seg_path(d, 1))
+    assert sub.apply_batch(header, tables) is False
+    assert sub.duplicate_batches == 1
+    assert sub.applied_batches == 1 and tgt.applies == 1
+    np.testing.assert_array_equal(tgt.tables["t"], snapshot)
+
+
+def test_out_of_order_within_window_buffers_then_drains_in_order(tmp_path):
+    d = str(tmp_path / "log")
+    pub = DeltaPublisher(d, base_step=0)
+    rows = np.array([5, 9], np.int64)
+    batches = {}
+    for seq, step in ((1, 1), (2, 2), (3, 3)):
+        pub.publish({"t": (rows, _vals(rows, 10 + seq))}, step)
+        batches[seq] = read_batch(seg_path(d, seq))
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d, window=8)
+    # deliver 3, 2, 1: the out-of-order pair buffers, seq 1 drains all
+    assert sub.apply_batch(*batches[3]) is False
+    assert sub.apply_batch(*batches[2]) is False
+    assert sub.status()["pending"] == 2 and sub.applied_batches == 0
+    assert sub.apply_batch(*batches[1]) is True
+    assert sub.applied_seq == 3 and sub.applied_step == 3
+    assert sub.status()["pending"] == 0 and sub.applied_batches == 3
+    # the same rows were written by every batch: seq 3's values must win
+    np.testing.assert_array_equal(
+        tgt.tables["t"][rows], batches[3][1]["t"]["values"])
+
+
+def test_gap_falls_back_and_resumes_past_the_dead_batch(tmp_path):
+    d = str(tmp_path / "log")
+    pub = DeltaPublisher(d, base_step=4)
+    rows = {1: np.array([1, 2], np.int64), 2: np.array([3, 4], np.int64),
+            3: np.array([5, 6], np.int64)}
+    vals = {s: _vals(rows[s], 20 + s) for s in rows}
+    pub.publish({"t": (rows[1], vals[1])}, 5)
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d, config=object(), checkpoint_root="ck")
+    assert sub.poll() == 1 and tgt.step == 5
+    pub.publish({"t": (rows[2], vals[2])}, 6)
+    pub.publish({"t": (rows[3], vals[3])}, 7)
+    os.remove(seg_path(d, 2))  # retention outran us: a real, permanent gap
+    assert sub.poll() == 0
+    assert sub.fallbacks == 1 and tgt.reloads == 1
+    # resumed PAST the missing segment — at or before it would re-trigger
+    # the same fallback on every poll forever
+    assert sub.next_seq == 3
+    assert sub.poll() == 1
+    assert sub.applied_seq == 3 and sub.fallbacks == 1
+    np.testing.assert_array_equal(tgt.tables["t"][rows[3]], vals[3])
+
+
+def test_publisher_restart_falls_back_then_adopts_the_new_stream(tmp_path):
+    d = str(tmp_path / "log")
+    rows = np.arange(4, dtype=np.int64)
+    a = DeltaPublisher(d, base_step=1)
+    a.publish({"t": (rows, _vals(rows, 1))}, 2)
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d, config=object(), checkpoint_root="ck")
+    assert sub.poll() == 1 and sub.publisher == a.id
+    b = DeltaPublisher(d, base_step=2)
+    new_vals = _vals(rows, 2)
+    b.publish({"t": (rows, new_vals)}, 3)
+    assert sub.poll() == 0  # changed publisher id IS the restart signal
+    assert sub.fallbacks == 1 and tgt.reloads == 1
+    assert sub.publisher == b.id
+    assert sub.poll() == 1
+    np.testing.assert_array_equal(tgt.tables["t"][rows], new_vals)
+
+
+# ------------------------------------------------------ quantized deltas ----
+
+
+def test_int8_delta_round_trip_matches_flush_requantized_rows(tmp_path):
+    d = str(tmp_path / "log")
+    rows = np.array([0, 3, 31, CAP - 1], np.int64)
+    vals = _vals(rows, 7) * np.array([[1e-3], [1.0], [40.0], [0.2]],
+                                     np.float32)
+    pub = DeltaPublisher(d, base_step=0, dtype="int8")
+    pub.publish({"t": (rows, vals)}, 1)
+    header, tables = read_batch(seg_path(d, 1))
+    assert header["dtype"] == "int8"
+    # the wire carries the SAME codes/scales a host-master reload would
+    # requantize to — so delta-served rows equal flush-requantized rows
+    codes, scales = _np_quant_unit_rows(vals)
+    np.testing.assert_array_equal(tables["t"]["values"], codes)
+    np.testing.assert_array_equal(tables["t"]["scales"], scales)
+    expect = _np_dequant_unit_rows(codes, scales, np.float32)
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d)
+    assert sub.poll() == 1
+    np.testing.assert_array_equal(tgt.tables["t"][rows], expect)
+
+
+# ------------------------------------------------------- fleet cutover ----
+
+
+def test_fleet_apply_lands_every_replica_on_one_version(tmp_path):
+    table = _vals(range(CAP), 0)
+
+    def factory(rid):
+        return Servant({"t": table}, batch_buckets=(8,), cache_rows=32)
+
+    fleet = Fleet(factory, replicas=3)
+    d = str(tmp_path / "log")
+    pub = DeltaPublisher(d, base_step=0)
+    rows = np.array([4, 8, 15], np.int64)
+    vals = _vals(rows, 5)
+    pub.publish({"t": (rows, vals)}, 2)
+    sub = DeltaSubscriber(fleet, d)
+    before = {rid: rep.servant.version
+              for rid, rep in fleet._replicas.items()}
+    assert sub.poll() == 1
+    versions = {rep.servant.version for rep in fleet._replicas.values()}
+    assert len(versions) == 1  # one shared epoch: no mixed-version serving
+    assert versions.pop() > max(before.values())
+    assert {rep.servant.step for rep in fleet._replicas.values()} == {2}
+    # both routed pulls serve the delta rows bit-identically
+    for rid in fleet._replicas:
+        np.testing.assert_array_equal(
+            np.asarray(fleet._replicas[rid].servant.pull(rows)), vals)
+
+
+# ------------------------------------------------- ledger / CI surfaces ----
+
+
+def test_failure_report_renders_delta_gap_and_fallback_lines(tmp_path):
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    tgt = FakeTarget()
+    d = str(tmp_path / "log")
+    pub = DeltaPublisher(d, base_step=0)
+    rows = np.arange(2, dtype=np.int64)
+    pub.publish({"t": (rows, _vals(rows, 1))}, 1)
+    pub.publish({"t": (rows, _vals(rows, 2))}, 2)
+    sub = DeltaSubscriber(tgt, d, config=object(), checkpoint_root="ck",
+                          ledger=led)
+    sub.poll()
+    os.remove(seg_path(d, 1))  # force a detectable gap on re-subscribe
+    sub._fallback("gap", failed_seq=1)
+    out = render_failures(led)
+    assert "DELTA-GAP" in out and "reason=gap" in out
+    assert "FRESHNESS-FALLBACK" in out and "recovered=True" in out
+
+
+def _bench_record(freshness, value=100_000.0):
+    return {"payload": {
+        "metric": "word2vec_words_per_sec_per_chip", "value": value,
+        "unit": "words/sec/chip", "platform": "tpu", "config": {},
+        "freshness": freshness,
+    }}
+
+
+def _fresh_block(parity=0.0, gap_recovered=True, gap_parity=0.0,
+                 lag=150.0, serve=5.0):
+    return {
+        "bit_parity": parity, "lag_p99_ms": lag, "lag_ceiling_ms": 2500.0,
+        "serve_p99_ms": serve, "slo_p99_ms": 60.0,
+        "gap_drill": {"recovered": gap_recovered, "parity": gap_parity},
+    }
+
+
+def test_freshness_gate_passes_then_trips_on_parity_and_lag(tmp_path):
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    led.append("bench", _bench_record(_fresh_block()))
+    rc, msg = check_regression(led, 10.0)
+    assert rc == 0 and "freshness ok" in msg
+    # non-zero bit parity is a hard correctness failure on ANY platform
+    led.append("bench", _bench_record(
+        _fresh_block(parity=0.01, lag=9000.0), value=101_000.0))
+    rc, msg = check_regression(led, 10.0)
+    assert rc == 1 and "freshness REGRESSION" in msg
+    assert "not bit-identical" in msg and "ceiling" in msg
+
+
+def test_freshness_gate_trips_on_unrecovered_gap_drill(tmp_path):
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    led.append("bench", _bench_record(
+        _fresh_block(gap_recovered=False, gap_parity=0.5)))
+    rc, msg = check_regression(led, 10.0)
+    assert rc == 1 and "gap drill did not recover" in msg
+    assert "post-fallback parity" in msg
+
+
+# ------------------------------------------------------------ the drill ----
+
+
+@pytest.mark.slow
+def test_freshness_chaos_drill_matrix_recovers(tmp_path):
+    from swiftsnails_tpu.freshness.bench_lane import freshness_chaos_drill
+
+    out = freshness_chaos_drill(small=True, workdir=str(tmp_path))
+    assert out["recovered_all"]
+    for name in ("publisher_kill", "corrupt_delta", "forced_gap"):
+        res = out[name]
+        assert res["recovered"], name
+        assert res["fallbacks"] >= 1 and res["parity"] == 0.0

@@ -18,6 +18,7 @@ resumed run whose final loss matches an undisturbed one.
     python tools/chaos_drill.py --serve    # the serving availability matrix
     python tools/chaos_drill.py --cluster  # the membership drill matrix
     python tools/chaos_drill.py --fleet    # the replica-fleet drill matrix
+    python tools/chaos_drill.py --freshness  # the delta-pipeline drill matrix
 
 ``--serve`` runs the CPU-valid availability drill instead (the bench
 ``chaos-serve`` lane): a seeded fault matrix against a live Servant with
@@ -33,6 +34,14 @@ replica of a 2-replica :class:`Fleet` gets sick mid-storm — killed with
 around it) or slowed with ``serve_slow`` stalls (tail hedges rescue the
 stragglers) — and the fleet must hold the availability floor through
 breaker-aware re-routing + hedging. Exit is nonzero on a missed floor.
+
+``--freshness`` runs the CPU-valid delta-pipeline drill matrix instead: a
+live 2-replica fleet subscribed to a hot-row delta log loses its publisher
+mid-stream (a new incarnation takes over), reads a bit-flipped delta batch
+(CRC), and hits a deleted segment (sequence gap) — each drill must fall
+back to a full checkpoint reload, resubscribe past the fault, and end with
+every replica on one shared version and parity 0.0 against the reference
+planes. Exit is nonzero on any unrecovered drill.
 
 ``--cluster`` runs the CPU-valid membership drill matrix instead (the bench
 ``chaos-cluster`` lane, one fault kind per drill): a simulated virtual-clock
@@ -123,6 +132,31 @@ def _fleet_matrix(args) -> int:
     return 1 if failed else 0
 
 
+def _freshness_matrix(args) -> int:
+    from swiftsnails_tpu.freshness.bench_lane import freshness_chaos_drill
+
+    out = freshness_chaos_drill(small=True, workdir=args.workdir)
+    results = {k: v for k, v in out.items() if isinstance(v, dict)}
+    failed = [k for k, v in results.items() if not v.get("recovered")]
+    if args.json:
+        print(json.dumps({"results": results, "failed": failed}))
+    else:
+        width = max(len(k) for k in results)
+        for name, res in results.items():
+            status = "RECOVERED" if res.get("recovered") else "UNRECOVERED"
+            detail = (
+                f"fallbacks={res['fallbacks']} "
+                f"parity={res['parity']} "
+                f"applied_seq={res['applied_seq']}"
+            )
+            print(f"{name:<{width}}  {status:<11}  {detail}")
+        print(
+            f"{len(results) - len(failed)}/{len(results)} drills recovered"
+            + (f"; FAILED: {', '.join(failed)}" if failed else "")
+        )
+    return 1 if failed else 0
+
+
 def _cluster_matrix(args) -> int:
     from swiftsnails_tpu.cluster.chaos_lane import run_cluster_drills
 
@@ -174,6 +208,11 @@ def main(argv=None) -> int:
                    help="run the replica-fleet drill matrix instead (kill/"
                         "slow one replica mid-storm; the fleet must hold the "
                         "availability floor via re-route + hedging)")
+    p.add_argument("--freshness", action="store_true",
+                   help="run the delta-pipeline drill matrix instead "
+                        "(publisher kill / corrupt delta / forced gap vs a "
+                        "subscribed fleet; each must fall back to a full "
+                        "checkpoint reload and converge to parity 0.0)")
     args = p.parse_args(argv)
 
     if args.serve:
@@ -182,6 +221,8 @@ def main(argv=None) -> int:
         return _cluster_matrix(args)
     if args.fleet:
         return _fleet_matrix(args)
+    if args.freshness:
+        return _freshness_matrix(args)
 
     from swiftsnails_tpu.resilience.drill import run_drill_matrix
 
