@@ -21,6 +21,7 @@ Usage::
     python -m swiftsnails_tpu ledger-report --check-regression 10   # bench gate
     python -m swiftsnails_tpu ledger-report --failures   # outage/chaos timeline
     python -m swiftsnails_tpu supervisor-status [LEDGER.jsonl]   # membership view
+    python -m swiftsnails_tpu ops [LEDGER.jsonl]   # one-screen fleet dashboard
     python -m swiftsnails_tpu worker -config ...   # alias of train (parity)
 
 Resilience (docs/RESILIENCE.md): ``resume: auto`` continues an interrupted
@@ -125,6 +126,7 @@ def cmd_serve(argv: List[str]) -> int:
         score <f0> <f1> ...          CTR probability (registry models)
         stats                        latency/cache/shed snapshot
         health                       breaker / tier / version state
+        ops                          one-screen dashboard (SLO / traces)
         add                          (fleet) add a replica to the ring
         drain <replica>              (fleet) drain + remove a replica
         subscribe <dir>              follow a hot-row delta log (freshness)
@@ -169,11 +171,11 @@ def cmd_serve(argv: List[str]) -> int:
         if fleet_mode:
             banner = (f"serving fleet of {replicas} replicas "
                       f"(one request per line; pull/topk/score/stats/"
-                      "health/add/drain/subscribe/freshness/quit)")
+                      "health/ops/add/drain/subscribe/freshness/quit)")
         else:
             banner = (f"serving step {servant.step} tables "
                       f"{servant.stats()['tables']} (one request per line; "
-                      "pull/topk/score/stats/health/subscribe/freshness/"
+                      "pull/topk/score/stats/health/ops/subscribe/freshness/"
                       "quit)")
         print(banner, file=sys.stderr)
         for line in sys.stdin:
@@ -200,6 +202,18 @@ def cmd_serve(argv: List[str]) -> int:
                     out = servant.stats()
                 elif op == "health":
                     out = servant.health()
+                elif op == "ops":
+                    from swiftsnails_tpu.telemetry.ops import render_ops
+
+                    tracer = getattr(servant, "request_tracer", None)
+                    anomalies = ([c.to_dict()
+                                  for c in tracer.anomaly_traces(5)]
+                                 if tracer is not None else None)
+                    text = render_ops(servant.stats(),
+                                      health=servant.health(),
+                                      anomalies=anomalies)
+                    print(text, file=sys.stderr)
+                    out = {"ops": "printed"}
                 elif op == "add" and fleet_mode:
                     out = {"added": servant.add_replica()}
                 elif op == "drain" and fleet_mode:
@@ -259,6 +273,15 @@ def cmd_ledger_report(argv: List[str]) -> int:
     return ledger_main(argv)
 
 
+def cmd_ops(argv: List[str]) -> int:
+    """One-screen fleet dashboard from the run ledger (docs/OBSERVABILITY.md):
+    newest fleet/freshness bench blocks, SLO error budget from ``slo_burn``
+    events, and the recent ``trace_anomaly`` tail with drillable trace ids."""
+    from swiftsnails_tpu.telemetry.ops import main as ops_main
+
+    return ops_main(argv)
+
+
 def cmd_supervisor_status(argv: List[str]) -> int:
     """Replay a run ledger's membership events into the supervisor's view:
     per-worker state (alive/lost, joins, straggler flags, where reassigned
@@ -314,12 +337,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_ledger_report(rest)
         if cmd == "supervisor-status":
             return cmd_supervisor_status(rest)
+        if cmd == "ops":
+            return cmd_ops(rest)
         if cmd in ("master", "server"):
             print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
             return 0
         print(
             f"unknown command {cmd!r}; try: train, export, serve, models, "
-            "trace-summary, ledger-report, supervisor-status",
+            "trace-summary, ledger-report, supervisor-status, ops",
             file=sys.stderr,
         )
         return 2

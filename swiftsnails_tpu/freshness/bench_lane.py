@@ -361,16 +361,26 @@ def freshness_chaos_drill(small: bool = True,
                 rng.choice(plane.shape[0], size=8, replace=False))
             return {"in_table": (rows.astype(np.int64), plane[rows])}
 
+        from swiftsnails_tpu.telemetry.request_trace import (
+            RequestTracer,
+            tree_complete,
+        )
+
         drills: Dict[str, Dict] = {}
         for drill in ("publisher_kill", "corrupt_delta", "forced_gap"):
             fleet = Fleet.from_checkpoint(
                 ck_root, cfg, replicas=2, ledger=ledger)
+            # tail-keep only: the gap->fallback must land as a complete,
+            # drillable span tree even at sample rate 0
+            tracer = RequestTracer(
+                0.0, anomaly_keep=True, seed=FRESHNESS_SEED)
             try:
                 d = os.path.join(workdir, drill)
-                pub = DeltaPublisher(d, base_step=1, ledger=ledger)
+                pub = DeltaPublisher(d, base_step=1, ledger=ledger,
+                                     request_tracer=tracer)
                 sub = DeltaSubscriber(
                     fleet, d, config=cfg, checkpoint_root=ck_root,
-                    ledger=ledger)
+                    ledger=ledger, request_tracer=tracer)
                 pub.publish(_batch(), step=2)
                 pub.publish(_batch(), step=3)
                 sub.subscribe()
@@ -399,13 +409,25 @@ def freshness_chaos_drill(small: bool = True,
                 parity = _full_parity(reference, first)
                 versions = {rid: rep.servant.version
                             for rid, rep in fleet._replicas.items()}
+                # the fallback must be drillable: a kept anomaly trace with
+                # the full detect -> reload -> resubscribe timeline
+                fb_traces = [
+                    t for t in (c.to_dict()
+                                for c in tracer.anomaly_traces())
+                    if "fallback" in t["anomalies"] and tree_complete(
+                        t, require=("detect", "reload", "resubscribe",
+                                    "request"))]
                 drills[drill] = {
                     "recovered": bool(st["fallbacks"] >= 1
                                       and parity == 0.0
-                                      and len(set(versions.values())) == 1),
+                                      and len(set(versions.values())) == 1
+                                      and fb_traces),
                     "fallbacks": st["fallbacks"],
                     "parity": parity,
                     "applied_seq": st["applied_seq"],
+                    "fallback_traces": len(fb_traces),
+                    "trace_id": (fb_traces[-1]["trace_id"]
+                                 if fb_traces else None),
                 }
             finally:
                 fleet.close()

@@ -107,7 +107,7 @@ class DeltaPublisher:
 
     def __init__(self, dirpath: str, *, base_step: int,
                  dtype: str = "float32", log_mb: float = 64.0,
-                 ledger=None):
+                 ledger=None, request_tracer=None):
         if dtype not in ("float32", "int8"):
             raise ValueError(
                 f"freshness_delta_dtype must be float32|int8, got {dtype!r}")
@@ -115,6 +115,7 @@ class DeltaPublisher:
         self.dtype = dtype
         self.log_mb = float(log_mb)
         self.ledger = ledger
+        self.request_tracer = request_tracer
         self.base_step = int(base_step)
         self.id = uuid.uuid4().hex[:12]
         self.seq = 0
@@ -165,6 +166,13 @@ class DeltaPublisher:
         if not tables:
             return None
         self.seq += 1
+        ctx = None
+        if self.request_tracer is not None:
+            try:
+                ctx = self.request_tracer.start(
+                    "delta_publish", publisher=self.id)
+            except Exception:
+                ctx = None  # tracing never blocks the publish path
         header = {
             "seq": self.seq,
             "publisher": self.id,
@@ -173,11 +181,29 @@ class DeltaPublisher:
             "ts_ns": time.time_ns(),
             "dtype": self.dtype,
         }
+        if ctx is not None:
+            # the wire form rides the batch header: the subscriber resumes
+            # this trace, so publish->apply->cutover is one drillable tree
+            try:
+                header["trace"] = ctx.wire()
+            except Exception:
+                pass
+        t_write = time.perf_counter_ns()
         path = write_batch(self.dir, header, tables)
         try:
             nbytes = os.path.getsize(path)
         except OSError:
             nbytes = 0
+        if ctx is not None:
+            try:
+                ctx.add_span("write", t_write,
+                             time.perf_counter_ns() - t_write,
+                             tables=len(tables))
+                ctx.annotate(seq=self.seq, step=int(step),
+                             rows=total_rows, bytes=nbytes)
+                self.request_tracer.finish(ctx)
+            except Exception:
+                pass
         self.published_batches += 1
         self.published_rows += total_rows
         self.published_bytes += nbytes
@@ -278,11 +304,22 @@ class TrainPublisher:
     ``on_batch`` each step and ``maybe_publish`` at the configured cadence
     (``freshness_publish`` steps; a final forced publish at end of run)."""
 
-    def __init__(self, trainer, *, tier=None, placement=None, ledger=None):
+    def __init__(self, trainer, *, tier=None, placement=None, ledger=None,
+                 request_tracer=None):
         cfg = trainer.config
         self.trainer = trainer
         self.tier = tier
         self.ledger = ledger
+        if request_tracer is None:
+            try:
+                from swiftsnails_tpu.telemetry.request_trace import (
+                    RequestTracer,
+                )
+                request_tracer = RequestTracer.from_config(
+                    cfg, ledger=ledger, source="freshness")
+            except Exception:
+                request_tracer = None
+        self.request_tracer = request_tracer
         self.period = cfg.get_int("freshness_publish", 0)
         self.dir = cfg.get_str("freshness_dir", "")
         self.dtype = cfg.get_str("freshness_delta_dtype", "float32")
@@ -312,7 +349,8 @@ class TrainPublisher:
             return
         self.pub = DeltaPublisher(
             self.dir, base_step=base_step, dtype=self.dtype,
-            log_mb=self.log_mb, ledger=self.ledger)
+            log_mb=self.log_mb, ledger=self.ledger,
+            request_tracer=self.request_tracer)
         if self.tier is not None and not self.tier.all_transparent:
             # dirty-flush tee: every landed write-back records its units
             for name, tt in self.tier.tables.items():

@@ -73,6 +73,7 @@ class DeltaSubscriber:
         max_lag_ms: float = 0.0,
         window: int = 64,
         ledger=None,
+        request_tracer=None,
     ):
         self.target = target
         self.dir = os.path.abspath(dirpath)
@@ -81,6 +82,16 @@ class DeltaSubscriber:
         self.max_lag_ms = float(max_lag_ms)
         self.window = max(int(window), 1)
         self.ledger = ledger
+        if request_tracer is None and config is not None:
+            try:
+                from swiftsnails_tpu.telemetry.request_trace import (
+                    RequestTracer,
+                )
+                request_tracer = RequestTracer.from_config(
+                    config, ledger=ledger, source="freshness")
+            except Exception:
+                request_tracer = None
+        self.request_tracer = request_tracer
         self._lock = threading.RLock()
         # stream position
         self.publisher: Optional[str] = None
@@ -196,15 +207,33 @@ class DeltaSubscriber:
     def _apply_now(self, header: Dict, tables: Dict) -> None:
         seq = int(header["seq"])
         step = int(header.get("step", 0) or 0)
+        rt = self.request_tracer
+        ctx = None
+        if rt is not None:
+            try:
+                # continue the publisher's trace (same id -> same sampling
+                # decision on both sides, no coordination needed)
+                ctx = rt.resume(header.get("trace"), "delta_apply",
+                                publisher=header.get("publisher"))
+                ctx.annotate(seq=seq, step=step)
+            except Exception:
+                ctx = None  # tracing never blocks the apply path
         if step <= self.floor_step:
             # the fallback reload already serves rows at/after this step
             self.skipped_batches += 1
             self.next_seq = seq + 1
             self.applied_seq = seq
+            if ctx is not None:
+                try:
+                    ctx.annotate(skipped=True, floor_step=self.floor_step)
+                    rt.finish(ctx)
+                except Exception:
+                    pass
             return
         dtype = header.get("dtype", "float32")
         updates = {}
         n_rows = 0
+        t_apply = time.perf_counter_ns()
         for name, t in tables.items():
             rows = np.asarray(t["rows"], np.int64)
             if dtype == "int8":
@@ -229,10 +258,14 @@ class DeltaSubscriber:
             n_rows += int(rows.size)
         if len(self._row_seq) > _ROW_SEQ_CAP:
             self._row_seq.clear()  # cheap reset: absolute values stay safe
+        apply_dur = time.perf_counter_ns() - t_apply
+        t_cutover = cutover_dur = 0
         if updates:
             # atomic version cutover inside; the step kwarg advances the
             # target's serving watermark to what the batch was current as of
+            t_cutover = time.perf_counter_ns()
             self.target.apply_rows(updates, step=step)
+            cutover_dur = time.perf_counter_ns() - t_cutover
         self.applied_seq = seq
         self.applied_step = max(self.applied_step, step)
         self.next_seq = seq + 1
@@ -242,6 +275,20 @@ class DeltaSubscriber:
         if ts_ns:
             self.last_lag_ms = max((time.time_ns() - ts_ns) / 1e6, 0.0)
             self._lag_ms.append(self.last_lag_ms)
+        if ctx is not None:
+            try:
+                ctx.add_span("apply", t_apply, apply_dur,
+                             rows=n_rows, tables=len(updates))
+                if updates:
+                    ctx.add_span("cutover", t_cutover, cutover_dur)
+                ctx.annotate(rows=n_rows,
+                             target_version=getattr(
+                                 self.target, "version", None))
+                if ts_ns:
+                    ctx.annotate(lag_ms=round(self.last_lag_ms, 3))
+                rt.finish(ctx)
+            except Exception:
+                pass
 
     # -- fallback ------------------------------------------------------------
 
@@ -255,6 +302,18 @@ class DeltaSubscriber:
         re-trigger the same fallback forever. The reload already re-based
         every row, so skipping the dead batch loses nothing durable."""
         self.fallbacks += 1
+        rt = self.request_tracer
+        ctx = None
+        if rt is not None:
+            try:
+                ctx = rt.start("delta_fallback")
+                ctx.mark_anomaly("fallback")  # tail-keep: always retrievable
+                ctx.annotate(reason=reason, failed_seq=failed_seq,
+                             next_seq=self.next_seq,
+                             applied_seq=self.applied_seq)
+            except Exception:
+                ctx = None
+        t_detect = time.perf_counter_ns()
         self._ledger_event({
             "phase": "detect",
             "reason": reason,
@@ -262,10 +321,14 @@ class DeltaSubscriber:
             "applied_seq": self.applied_seq,
             "fallbacks": self.fallbacks,
         })
+        detect_dur = time.perf_counter_ns() - t_detect
         version = None
+        t_reload = reload_dur = 0
         if self.checkpoint_root and self.config is not None:
+            t_reload = time.perf_counter_ns()
             version = self.target.reload_from_checkpoint(
                 self.checkpoint_root, self.config)
+            reload_dur = time.perf_counter_ns() - t_reload
             # a batch current as of a step the reload already covers must
             # not re-apply on top of the newer planes
             self.floor_step = int(getattr(self.target, "step", 0) or 0)
@@ -273,6 +336,7 @@ class DeltaSubscriber:
         self._row_seq.clear()  # the reload re-based every row
         prev = self.publisher
         self.publisher = None
+        t_resub = time.perf_counter_ns()
         self.subscribe()
         if (failed_seq is not None and self.publisher is not None
                 and self.publisher == prev):
@@ -282,6 +346,7 @@ class DeltaSubscriber:
             later = [s for s in list_seqs(self.dir) if s > failed_seq]
             self.next_seq = max(
                 self.next_seq, later[0] if later else failed_seq + 1)
+        resub_dur = time.perf_counter_ns() - t_resub
         self._ledger_event({
             "phase": "fallback",
             "reason": reason,
@@ -290,6 +355,20 @@ class DeltaSubscriber:
             "resubscribed_seq": self.next_seq,
             "floor_step": self.floor_step,
         })
+        if ctx is not None:
+            try:
+                ctx.add_span("detect", t_detect, detect_dur, reason=reason)
+                if t_reload:
+                    ctx.add_span("reload", t_reload, reload_dur,
+                                 version=version)
+                ctx.add_span("resubscribe", t_resub, resub_dur,
+                             resubscribed_seq=self.next_seq)
+                ctx.annotate(recovered=True, version=version,
+                             resubscribed_seq=self.next_seq,
+                             floor_step=self.floor_step)
+                rt.finish(ctx)
+            except Exception:
+                pass
 
     def _ledger_event(self, record: Dict) -> None:
         if self.ledger is None:
@@ -351,4 +430,6 @@ class DeltaSubscriber:
                 "stale": bool(self.max_lag_ms > 0
                               and self.last_lag_ms > self.max_lag_ms),
                 "polling": self._thread is not None,
+                **({"trace": self.request_tracer.stats()}
+                   if self.request_tracer is not None else {}),
             }

@@ -65,6 +65,7 @@ import jax.numpy as jnp
 from swiftsnails_tpu.serving.breaker import CLOSED, CircuitBreaker, Unavailable
 from swiftsnails_tpu.serving.cache import HotRowCache
 from swiftsnails_tpu.serving.kernels import pull_rows, topk_tiled
+from swiftsnails_tpu.telemetry import request_trace
 
 DEFAULT_BUCKETS = (8, 64)
 DEFAULT_BREAKER_THRESHOLD = 5
@@ -169,7 +170,8 @@ def _normalize_state_tables(state, config, scorer, mesh):
 
 
 class _Request:
-    __slots__ = ("payload", "n", "event", "result", "error", "t0")
+    __slots__ = ("payload", "n", "event", "result", "error", "t0",
+                 "t_dispatch", "kernel_ms", "pad_buckets", "pad_rows")
 
     def __init__(self, payload: Dict, n: int):
         self.payload = payload
@@ -178,6 +180,14 @@ class _Request:
         self.result = None
         self.error: Optional[BaseException] = None
         self.t0 = time.perf_counter()
+        # dispatcher-thread stamps: when the batch was taken, how long the
+        # kernel ran, and the pad buckets it rode in. The *request* thread
+        # turns these into retroactive trace spans (queue-wait / kernel)
+        # after _wait returns — the dispatcher never touches the context.
+        self.t_dispatch = 0.0
+        self.kernel_ms = 0.0
+        self.pad_buckets: Tuple[int, ...] = ()
+        self.pad_rows = 0
 
 
 class MicroBatcher:
@@ -330,10 +340,17 @@ class Servant:
         breaker_cooldown_ms: float = DEFAULT_BREAKER_COOLDOWN_MS,
         breaker_halfopen_probes: int = DEFAULT_BREAKER_PROBES,
         degraded: bool = True,
+        request_tracer=None,
+        slo=None,
     ):
         if not tables:
             raise ValueError("Servant needs at least one table")
         self.mesh = mesh
+        # ops plane: a telemetry RequestTracer captures per-request span
+        # trees (head-sampled + anomaly tail-keep); an SloTracker burns the
+        # error budget. Both optional — None costs one attribute check.
+        self.request_tracer = request_tracer
+        self.slo = slo
         self.comm_dtype = comm_dtype
         self.topk_default = int(topk)
         self.topk_tile_rows = int(topk_tile_rows)
@@ -542,6 +559,16 @@ class Servant:
         kwargs.setdefault("breaker_halfopen_probes", config.get_int(
             "breaker_halfopen_probes", DEFAULT_BREAKER_PROBES))
         kwargs.setdefault("degraded", config.get_bool("serve_degraded", True))
+        if "request_tracer" not in kwargs:
+            from swiftsnails_tpu.telemetry.request_trace import RequestTracer
+
+            kwargs["request_tracer"] = RequestTracer.from_config(
+                config, ledger=kwargs.get("ledger"))
+        if "slo" not in kwargs:
+            from swiftsnails_tpu.telemetry.slo import SloTracker
+
+            kwargs["slo"] = SloTracker.from_config(
+                config, ledger=kwargs.get("ledger"))
         if config.get_str("table_tier", "device") == "host":
             kwargs.setdefault(
                 "tier_hbm_budget_mb",
@@ -717,11 +744,29 @@ class Servant:
         t0 = time.perf_counter()
         name = table or self.default_table
         ids = np.asarray(ids, np.int32).reshape(-1)
+        ctx, owned = self._trace_begin("pull", table=name, n=len(ids))
+        try:
+            with request_trace.use(ctx):
+                out = self._pull_traced(name, ids, t0, ctx)
+        except BaseException as e:
+            self._trace_end("pull", ctx, owned, t0, error=e)
+            raise
+        self._trace_end("pull", ctx, owned, t0)
+        return out
+
+    def _pull_traced(self, name: str, ids: np.ndarray, t0: float,
+                     ctx) -> np.ndarray:
         version = self.version
         found, missing = self.cache.get_many(name, version, ids)
+        if ctx is not None:
+            ctx.annotate(table=name, table_version=version,
+                         cache_hits=len(found), cache_misses=len(missing))
+            self._annotate_freshness(ctx)
         if missing:
             br = self.breakers.get("pull")
             if br is not None and not br.allow():
+                if ctx is not None:
+                    ctx.annotate(breaker="open")
                 return self._pull_degraded(name, ids, t0, reason="open")
             try:
                 req = self._batchers["pull"].submit(
@@ -741,12 +786,13 @@ class Servant:
                 raise
             if br is not None:
                 br.record_success()
+            self._trace_dispatch(ctx, req)
             found.update(
                 (int(i), pulled[n]) for n, i in enumerate(missing)
             )
         out = np.stack([found[int(i)] for i in ids]) if len(ids) else \
             np.zeros((0,) + self._tables[name].shape[1:], np.float32)
-        self._observe("pull", t0, units=len(ids))
+        self._observe("pull", t0, units=len(ids), ctx=ctx)
         return out
 
     def _pull_degraded(self, name: str, ids: np.ndarray, t0: float,
@@ -758,7 +804,8 @@ class Servant:
             found, missing = self.cache.get_stale(name, ids)
             if not missing:
                 self._note_degraded("pull", len(ids), reason)
-                self._observe("pull", t0, units=len(ids))
+                self._observe("pull", t0, units=len(ids),
+                              ctx=request_trace.current())
                 return np.stack([found[int(i)] for i in ids]) if len(ids) \
                     else np.zeros(
                         (0,) + self._tables[name].shape[1:], np.float32)
@@ -785,18 +832,25 @@ class Servant:
         name = table or self.default_table
         k = int(k or self.topk_default)
         q = np.asarray(query, np.float32).reshape(1, -1)
-        scores, ids = self._guarded_dispatch(
-            "topk",
-            {"table": name, "queries": q, "k": k + len(exclude),
-             "normalize": normalize},
-            n=1,
-        )  # ([1, k+x], [1, k+x])
+        ctx, owned = self._trace_begin("topk", table=name, k=k)
+        try:
+            with request_trace.use(ctx):
+                scores, ids = self._guarded_dispatch(
+                    "topk",
+                    {"table": name, "queries": q, "k": k + len(exclude),
+                     "normalize": normalize},
+                    n=1,
+                )  # ([1, k+x], [1, k+x])
+        except BaseException as e:
+            self._trace_end("topk", ctx, owned, t0, error=e)
+            raise
         out = [
             (int(i), float(s))
             for i, s in zip(ids[0], scores[0])
             if int(i) not in set(int(e) for e in exclude) and int(i) >= 0
         ][:k]
-        self._observe("topk", t0, units=1)
+        self._observe("topk", t0, units=1, ctx=ctx)
+        self._trace_end("topk", ctx, owned, t0)
         return out
 
     def score(self, feats) -> np.ndarray:
@@ -807,8 +861,16 @@ class Servant:
         feats = np.asarray(feats, np.int32)
         if feats.ndim == 1:
             feats = feats[None, :]
-        out = self._guarded_dispatch("score", {"feats": feats}, n=len(feats))
-        self._observe("score", t0, units=len(feats))
+        ctx, owned = self._trace_begin("score", n=len(feats))
+        try:
+            with request_trace.use(ctx):
+                out = self._guarded_dispatch(
+                    "score", {"feats": feats}, n=len(feats))
+        except BaseException as e:
+            self._trace_end("score", ctx, owned, t0, error=e)
+            raise
+        self._observe("score", t0, units=len(feats), ctx=ctx)
+        self._trace_end("score", ctx, owned, t0)
         return out
 
     def _guarded_dispatch(self, kernel: str, payload: Dict, n: int):
@@ -819,9 +881,13 @@ class Servant:
         br = self.breakers.get(kernel)
         if br is not None and not br.allow():
             self.registry.counter(f"serve.{kernel}.unavailable").inc()
+            ctx = request_trace.current()
+            if ctx is not None:
+                ctx.annotate(breaker="open")
             raise Unavailable(f"{kernel}: breaker open; request shed")
         try:
-            result = _wait(self._batchers[kernel].submit(payload, n=n))
+            req = self._batchers[kernel].submit(payload, n=n)
+            result = _wait(req)
         except Overloaded:
             raise  # queue pressure, not kernel health
         except Exception:
@@ -830,6 +896,7 @@ class Servant:
             raise
         if br is not None:
             br.record_success()
+        self._trace_dispatch(request_trace.current(), req)
         return result
 
     # -- dispatch (batcher thread) ----------------------------------------
@@ -853,7 +920,9 @@ class Servant:
             by_table.setdefault(req.payload["table"], []).append(req)
         for name, reqs in by_table.items():
             ids = np.concatenate([r.payload["ids"] for r in reqs])
-            rows = self._pull_padded(name, ids)
+            t_disp = time.perf_counter()
+            rows, buckets, pad_rows = self._pull_padded(name, ids)
+            kernel_ms = (time.perf_counter() - t_disp) * 1e3
             # split back per request; insert REAL rows into the cache (pad
             # rows never reach here — _pull_padded slices them off)
             version = reqs[0].payload["version"]
@@ -861,18 +930,27 @@ class Servant:
                 self.cache.put_many(name, version, ids, rows)
             off = 0
             for req in reqs:
+                req.t_dispatch = t_disp
+                req.kernel_ms = kernel_ms
+                req.pad_buckets = buckets
+                req.pad_rows = pad_rows
                 req.result = rows[off : off + req.n]
                 off += req.n
                 req.event.set()
 
-    def _pull_padded(self, name: str, ids: np.ndarray) -> np.ndarray:
+    def _pull_padded(
+        self, name: str, ids: np.ndarray,
+    ) -> Tuple[np.ndarray, Tuple[int, ...], int]:
         """Chunk at the largest bucket, pad each chunk to its bucket with
         the sentinel row, pull, slice the pads off. Pad rows are excluded
         from the pulled-rows counter (they count as ``pad_rows``) and are
-        never cached."""
+        never cached. Returns ``(rows, buckets_used, pad_rows)`` so the
+        dispatcher can stamp pad attribution onto each request's trace."""
         table = self._tables[name]
         cap = self.buckets[-1]
         out: List[np.ndarray] = []
+        buckets_used: List[int] = []
+        pad_total = 0
         for lo in range(0, len(ids), cap):
             chunk = ids[lo : lo + cap]
             b = bucket_for(len(chunk), self.buckets)
@@ -885,10 +963,13 @@ class Servant:
             else:
                 vals = np.asarray(self._pull_fn(table, jnp.asarray(padded)))
             out.append(vals[: len(chunk)])
+            buckets_used.append(b)
+            pad_total += pad
             self.registry.counter("serve.pull.rows").inc(len(chunk))
             self.registry.counter("serve.pull.pad_rows").inc(pad)
-        return np.concatenate(out) if out else np.zeros(
+        rows = np.concatenate(out) if out else np.zeros(
             (0, table.shape[1]), np.float32)
+        return rows, tuple(buckets_used), pad_total
 
     def _dispatch_topk(self, batch: List[_Request]) -> None:
         self._maybe_fault("topk")
@@ -901,6 +982,9 @@ class Servant:
         for (name, k, normalize), reqs in by_key.items():
             table = self._tables[name]
             queries = np.concatenate([r.payload["queries"] for r in reqs])
+            t_disp = time.perf_counter()
+            pad_total = 0
+            buckets_used: List[int] = []
             cap = self.buckets[-1]
             all_s: List[np.ndarray] = []
             all_i: List[np.ndarray] = []
@@ -922,12 +1006,19 @@ class Servant:
                     )
                 all_s.append(np.asarray(s)[: len(chunk)])
                 all_i.append(np.asarray(i)[: len(chunk)])
+                buckets_used.append(b)
+                pad_total += pad
                 self.registry.counter("serve.topk.queries").inc(len(chunk))
                 self.registry.counter("serve.topk.pad_rows").inc(pad)
             s = np.concatenate(all_s)
             i = np.concatenate(all_i)
+            kernel_ms = (time.perf_counter() - t_disp) * 1e3
             off = 0
             for req in reqs:
+                req.t_dispatch = t_disp
+                req.kernel_ms = kernel_ms
+                req.pad_buckets = tuple(buckets_used)
+                req.pad_rows = pad_total
                 req.result = (s[off : off + req.n], i[off : off + req.n])
                 off += req.n
                 req.event.set()
@@ -960,6 +1051,9 @@ class Servant:
         self._maybe_fault("score")
         table = self._tables[self.default_table]
         feats = np.concatenate([r.payload["feats"] for r in batch])
+        t_disp = time.perf_counter()
+        pad_total = 0
+        buckets_used: List[int] = []
         cap = self.buckets[-1]
         outs: List[np.ndarray] = []
         for lo in range(0, len(feats), cap):
@@ -976,21 +1070,94 @@ class Servant:
                     self._score_fn(table, self._dense, jnp.asarray(padded))
                 )
             outs.append(scores[: len(chunk)])
+            buckets_used.append(b)
+            pad_total += pad
             self.registry.counter("serve.score.rows").inc(len(chunk))
             self.registry.counter("serve.score.pad_rows").inc(pad)
         scores = np.concatenate(outs)
+        kernel_ms = (time.perf_counter() - t_disp) * 1e3
         off = 0
         for req in batch:
+            req.t_dispatch = t_disp
+            req.kernel_ms = kernel_ms
+            req.pad_buckets = tuple(buckets_used)
+            req.pad_rows = pad_total
             req.result = scores[off : off + req.n]
             off += req.n
             req.event.set()
 
+    # -- request tracing ---------------------------------------------------
+
+    def _trace_begin(self, kernel: str, **baggage):
+        """Join the thread's active request context (a fleet leg carried one
+        in), or mint a fresh trace when this servant fronts the request and
+        a tracer is attached. Returns ``(ctx, owned)`` — only an owned
+        context is finished here."""
+        ctx = request_trace.current()
+        if ctx is not None:
+            return ctx, False
+        rt = self.request_tracer
+        if rt is None:
+            return None, False
+        try:
+            return rt.start(kernel, **baggage), True
+        except Exception:
+            return None, False  # tracing never blocks the serve path
+
+    def _trace_end(self, kernel: str, ctx, owned: bool, t0: float,
+                   error: Optional[BaseException] = None) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        if self.slo is not None:
+            try:
+                self.slo.record(kernel, ms, ok=error is None)
+            except Exception:
+                pass  # record-keeping never blocks the serve path
+        if owned and ctx is not None and self.request_tracer is not None:
+            try:
+                self.request_tracer.finish(ctx, error=error)
+            except Exception:
+                pass
+
+    @staticmethod
+    def _trace_dispatch(ctx, req: _Request) -> None:
+        """Turn the dispatcher-thread stamps on ``req`` into retroactive
+        child spans: admission-queue wait, then batch kernel time with the
+        pad buckets it rode in."""
+        if ctx is None or not req.t_dispatch:
+            return
+        try:
+            ctx.add_span("queue-wait", int(req.t0 * 1e9),
+                         int((req.t_dispatch - req.t0) * 1e9))
+            ctx.add_span("kernel", int(req.t_dispatch * 1e9),
+                         int(req.kernel_ms * 1e6),
+                         buckets=list(req.pad_buckets),
+                         pad_rows=req.pad_rows)
+        except Exception:
+            pass  # tracing never blocks the serve path
+
+    def _annotate_freshness(self, ctx) -> None:
+        """Stamp the freshness the request is served at: the table version
+        plus the delta-subscriber watermark (trainer step / age)."""
+        fr = self._freshness
+        if fr is None:
+            return
+        try:
+            ctx.annotate(watermark_step=fr.applied_step,
+                         watermark_age_ms=round(fr.last_lag_ms, 3))
+        except Exception:
+            pass
+
     # -- metrics -----------------------------------------------------------
 
-    def _observe(self, kernel: str, t0: float, units: int) -> None:
+    def _observe(self, kernel: str, t0: float, units: int, ctx=None) -> None:
         ms = (time.perf_counter() - t0) * 1e3
         self._latency[kernel].append(ms)
-        self.registry.histogram(f"serve.{kernel}.latency_ms").observe(ms)
+        # exemplar: only link traces that will actually be kept (sampled or
+        # already anomalous) — a dropped trace id would dangle
+        tid = ctx.trace_id if ctx is not None and \
+            (ctx.sampled or ctx.anomalous) else None
+        self.registry.histogram(f"serve.{kernel}.latency_ms").observe(
+            ms, trace_id=tid)
         self.registry.counter(f"serve.{kernel}.requests").inc()
 
     def _on_breaker_transition(self, kernel: str, old: str, new: str,
@@ -1016,6 +1183,10 @@ class Servant:
     def _note_degraded(self, kernel: str, rows: int, reason: str) -> None:
         """Count a degraded (stale-LRU) serve — a separate ledger/metric
         stream from the fresh counters, rate-limited like overloads."""
+        ctx = request_trace.current()
+        if ctx is not None:
+            ctx.mark_anomaly("degraded")
+            ctx.annotate(degraded_reason=reason)
         self.registry.counter(f"serve.{kernel}.degraded").inc()
         self.registry.counter("serve.degraded_hits").inc(rows)
         total = int(self.registry.counter(f"serve.{kernel}.degraded").value)
@@ -1033,6 +1204,9 @@ class Servant:
                 pass
 
     def _note_shed(self, kernel: str) -> None:
+        ctx = request_trace.current()
+        if ctx is not None:
+            ctx.mark_anomaly("shed")
         self.registry.counter(f"serve.{kernel}.shed").inc()
         self.registry.counter("serve.shed").inc()
         total = int(self.registry.counter("serve.shed").value)
@@ -1124,6 +1298,9 @@ class Servant:
                     for name, tt in self.tier.items()
                 },
             }} if self.tier else {}),
+            **({"trace": self.request_tracer.stats()}
+               if self.request_tracer is not None else {}),
+            **({"slo": self.slo.snapshot()} if self.slo is not None else {}),
         }
 
     def health(self) -> Dict:

@@ -48,7 +48,7 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -69,9 +69,11 @@ from swiftsnails_tpu.serving.router import (
     EwmaQuantile,
     HashRing,
     HedgeGovernor,
+    route_annotation,
     route_hash,
     spill_order,
 )
+from swiftsnails_tpu.telemetry import request_trace
 
 ACTIVE = "active"
 DRAINING = "draining"
@@ -186,6 +188,8 @@ class Fleet:
         affinity: bool = True,
         max_inflight: int = 64,
         clock: Callable[[], float] = time.perf_counter,
+        request_tracer=None,
+        slo=None,
     ):
         if replicas < 1:
             raise ValueError("a fleet needs at least one replica")
@@ -195,6 +199,11 @@ class Fleet:
             registry = MetricRegistry()
         self.registry = registry
         self.ledger = ledger
+        # ops plane: one fleet-level RequestTracer owns each request's span
+        # tree (per-attempt child spans ride in from replica servants via
+        # the thread-local context); one SloTracker burns the error budget.
+        self.request_tracer = request_tracer
+        self.slo = slo
         self.affinity = bool(affinity)
         self.ring_spill = float(ring_spill)
         self.hedge_p95_ms = float(hedge_p95_ms)
@@ -242,9 +251,21 @@ class Fleet:
         ``serve_hedge_budget_pct``, ``serve_hedge_p95_ms``,
         ``serve_ring_spill``.
         """
+        # trace + SLO live at the FLEET level (one trace per request, one
+        # budget per fleet); replicas join the active context instead of
+        # minting their own, so their servants get neither
+        from swiftsnails_tpu.telemetry.request_trace import RequestTracer
+        from swiftsnails_tpu.telemetry.slo import SloTracker
+
+        tracer = servant_kwargs.pop(
+            "request_tracer", None) or RequestTracer.from_config(
+                config, ledger=ledger, source="fleet")
+        slo = servant_kwargs.pop(
+            "slo", None) or SloTracker.from_config(
+                config, ledger=ledger, source="fleet")
         proto = Servant.from_checkpoint(
             root, config, step=step, mesh=mesh, ledger=ledger,
-            **servant_kwargs)
+            request_tracer=None, slo=None, **servant_kwargs)
         n = int(replicas) if replicas is not None else \
             config.get_int("serve_replicas", 1)
 
@@ -284,6 +305,8 @@ class Fleet:
             hedge_p95_ms=config.get_float(
                 "serve_hedge_p95_ms", DEFAULT_HEDGE_P95_MS),
             ring_spill=config.get_float("serve_ring_spill", DEFAULT_SPILL),
+            request_tracer=tracer,
+            slo=slo,
         )
 
     def _add(self, servant: Optional[Servant] = None) -> Replica:
@@ -485,17 +508,20 @@ class Fleet:
         br = rep.servant.breakers.get(kernel)
         return br is not None and br.state == OPEN
 
-    def _route(self, kernel: str, key) -> List[Replica]:
+    def _route(self, kernel: str, key) -> Tuple[List[Replica], Dict]:
         """Candidate replicas, best first: ring order from the key's owner
         (or least-loaded when there is no affinity key), open-breaker
         replicas demoted to last resort, bounded-load spill applied within
-        the healthy prefix."""
+        the healthy prefix. Returns ``(candidates, decision)`` — the
+        decision is the owner-vs-spill annotation a request trace records.
+        """
+        keyed = self.affinity and key is not None
         with self._lock:
             active = {rid: r for rid, r in self._replicas.items()
                       if r.state == ACTIVE}
             if not active:
                 raise Unavailable("fleet: no active replicas")
-            if self.affinity and key is not None:
+            if keyed:
                 order = [active[rid]
                          for rid in self._ring.successors(route_hash(key))
                          if rid in active]
@@ -513,13 +539,16 @@ class Fleet:
         last_resort = [r for r in order if self._breaker_open(r, kernel)]
         if not healthy:
             self.registry.counter("fleet.route_last_resort").inc()
-            return last_resort
+            return last_resort, route_annotation(
+                [r.id for r in order], [r.id for r in last_resort],
+                affinity=keyed, last_resort=True)
         picked, spilled, _cap = spill_order(
             healthy, lambda r: r.load(kernel),
             spill=self.ring_spill, active=len(order))
         if spilled:
             self.registry.counter("fleet.spill").inc()
-        return picked + last_resort
+        return picked + last_resort, route_annotation(
+            [r.id for r in order], [r.id for r in picked], affinity=keyed)
 
     # -- request path ------------------------------------------------------
 
@@ -551,9 +580,49 @@ class Fleet:
 
     def _request(self, kernel: str, key, fn: Callable[[Servant], Any]):
         t0 = self._clock()
+        rt = self.request_tracer
+        ctx = None
+        if rt is not None:
+            try:
+                ctx = rt.start(kernel)
+            except Exception:
+                ctx = None  # tracing never blocks the serve path
+        try:
+            result = self._request_traced(kernel, key, fn, t0, ctx)
+        except BaseException as e:
+            self._finish_request(kernel, t0, ctx, error=e)
+            raise
+        self._finish_request(kernel, t0, ctx)
+        return result
+
+    def _finish_request(self, kernel: str, t0: float, ctx,
+                        error: Optional[BaseException] = None) -> None:
+        if self.slo is not None:
+            try:
+                self.slo.record(kernel, (self._clock() - t0) * 1e3,
+                                ok=error is None)
+            except Exception:
+                pass  # record-keeping never blocks the serve path
+        if ctx is not None and self.request_tracer is not None:
+            try:
+                self.request_tracer.finish(ctx, error=error)
+            except Exception:
+                pass
+
+    def _request_traced(self, kernel: str, key,
+                        fn: Callable[[Servant], Any], t0: float, ctx):
         self._gov.note_request()
         self.registry.counter(f"fleet.{kernel}.requests").inc()
-        candidates = self._route(kernel, key)
+        candidates, decision = self._route(kernel, key)
+        if ctx is not None:
+            ctx.annotate(**decision)
+            fr = self._freshness
+            if fr is not None:
+                try:
+                    ctx.annotate(watermark_step=fr.applied_step,
+                                 watermark_age_ms=round(fr.last_lag_ms, 3))
+                except Exception:
+                    pass
         flight = _Flight()
         launched: List[Replica] = []
 
@@ -561,7 +630,8 @@ class Fleet:
             flight.arm()
             launched.append(rep)
             rep.begin()
-            self._pool.submit(self._run_leg, flight, rep, kernel, fn, hedged)
+            self._pool.submit(self._run_leg, flight, rep, kernel, fn,
+                              hedged, ctx)
 
         launch(candidates[0], hedged=False)
         budget_s = self._p95[kernel].value / 1e3
@@ -574,6 +644,10 @@ class Fleet:
                 self.registry.counter(f"fleet.{kernel}.hedged").inc()
                 self._note_hedge(kernel, candidates[0].id, hedge_to.id,
                                  budget_s * 1e3)
+                if ctx is not None:
+                    ctx.mark_anomaly("hedge")
+                    ctx.annotate(hedge_to=hedge_to.id,
+                                 hedge_budget_ms=round(budget_s * 1e3, 3))
                 launch(hedge_to, hedged=True)
         if not flight.done.wait(timeout=_REQUEST_TIMEOUT_S):
             raise TimeoutError(f"fleet {kernel} request timed out")
@@ -582,7 +656,9 @@ class Fleet:
             rid, result, hedged = flight.winner
             if hedged:
                 self.registry.counter("serve.hedge_won").inc()
-            self._observe(kernel, t0)
+            if ctx is not None:
+                ctx.annotate(winner=rid, winner_hedged=hedged)
+            self._observe(kernel, t0, ctx)
             return result
 
         # every launched leg failed: one synchronous re-route when the
@@ -595,20 +671,46 @@ class Fleet:
                 if rep in launched or rep.state != ACTIVE:
                     continue
                 self.registry.counter("fleet.reroute").inc()
+                if ctx is not None:
+                    ctx.mark_anomaly("reroute")
                 rep.begin()
                 try:
-                    result = fn(rep.servant)
+                    with request_trace.use(ctx):
+                        if ctx is not None:
+                            with ctx.span("reroute", replica=rep.id) as sp:
+                                result = fn(rep.servant)
+                                sp.set(outcome="won")
+                        else:
+                            result = fn(rep.servant)
                 except BaseException as e:  # noqa: BLE001 — keep first error type
                     err = e
                     continue
                 finally:
                     rep.end()
-                self._observe(kernel, t0)
+                if ctx is not None:
+                    ctx.annotate(winner=rep.id, rerouted=True)
+                self._observe(kernel, t0, ctx)
                 return result
         raise err
 
     def _run_leg(self, flight: _Flight, rep: Replica, kernel: str,
-                 fn: Callable[[Servant], Any], hedged: bool) -> None:
+                 fn: Callable[[Servant], Any], hedged: bool,
+                 ctx=None) -> None:
+        # per-attempt child span: replica, breaker state at admission, and
+        # the first-writer-wins outcome. The thread-local activation lets
+        # the replica servant hang its queue-wait/kernel spans inside this
+        # attempt rather than minting its own trace.
+        sp = None
+        if ctx is not None:
+            try:
+                br = rep.servant.breakers.get(kernel)
+                sp = ctx.span("attempt", replica=rep.id, hedged=hedged,
+                              breaker=br.state if br is not None else "none")
+                sp.__enter__()
+            except Exception:
+                sp = None
+        activation = request_trace.use(ctx)
+        activation.__enter__()
         try:
             hook = rep.request_hook
             if hook is not None:
@@ -618,16 +720,30 @@ class Fleet:
             result, error = None, e
         finally:
             rep.end()
+            activation.__exit__(None, None, None)
         won = flight.complete(rep.id, result, error, hedged)
+        if sp is not None:
+            try:
+                sp.set(outcome="won" if won else
+                       ("error" if error is not None else "lost"))
+                if error is not None:
+                    sp.set(error=type(error).__name__)
+                sp.__exit__(None, None, None)
+            except Exception:
+                pass
         if hedged and not won and error is None:
             self.registry.counter("serve.hedge_lost").inc()
 
     # -- metrics / events --------------------------------------------------
 
-    def _observe(self, kernel: str, t0: float) -> None:
+    def _observe(self, kernel: str, t0: float, ctx=None) -> None:
         ms = (self._clock() - t0) * 1e3
         self._p95[kernel].observe(ms)
-        self.registry.histogram(f"fleet.{kernel}.latency_ms").observe(ms)
+        # exemplar: only link traces that will be kept (sampled/anomalous)
+        tid = ctx.trace_id if ctx is not None and \
+            (ctx.sampled or ctx.anomalous) else None
+        self.registry.histogram(f"fleet.{kernel}.latency_ms").observe(
+            ms, trace_id=tid)
 
     def _note_hedge(self, kernel: str, primary: str, hedge: str,
                     budget_ms: float) -> None:
@@ -700,6 +816,9 @@ class Fleet:
             "replicas_added": int(reg.counter("fleet.replicas_added").value),
             "replicas_drained": int(
                 reg.counter("fleet.replicas_drained").value),
+            **({"trace": self.request_tracer.stats()}
+               if self.request_tracer is not None else {}),
+            **({"slo": self.slo.snapshot()} if self.slo is not None else {}),
         }
 
     def health(self) -> Dict:

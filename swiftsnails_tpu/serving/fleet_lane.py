@@ -33,6 +33,7 @@ Two controlled comparisons ride along, both at equal offered load:
 from __future__ import annotations
 
 import os
+import statistics
 import tempfile
 import time
 from typing import Callable, Dict, List, Optional
@@ -42,6 +43,10 @@ import numpy as np
 from swiftsnails_tpu.serving.bench_lane import _build_word2vec_checkpoint
 from swiftsnails_tpu.serving.fleet import Fleet
 from swiftsnails_tpu.serving.loadgen import run_open_loop
+from swiftsnails_tpu.telemetry.request_trace import (
+    RequestTracer,
+    tree_complete,
+)
 
 FLEET_SEED = 13
 SLO_P99_MS = 60.0
@@ -50,6 +55,8 @@ BATCH = 8
 ZIPF_A = 1.1
 SCALING_FLOOR = 1.6
 AVAILABILITY_FLOOR_PCT = 99.0
+TRACE_OVERHEAD_CEIL_PCT = 3.0
+TRACE_SAMPLE_RATE = 0.1
 _BASE_QPS = 30.0
 _LADDER_GROWTH = 1.35
 _MAX_POINTS = 12
@@ -261,6 +268,73 @@ def _hedge_leg(
         }
 
 
+def _trace_overhead_leg(
+    mk_fleet: Callable[..., Fleet],
+    *,
+    capacity: int,
+    qps: float,
+    duration_s: float,
+    seed: int,
+    reps: int = 3,
+) -> Dict:
+    """Tracing on vs off at equal offered load — the ride-along that keeps
+    the observability plane honest: head sampling at ``TRACE_SAMPLE_RATE``
+    plus tail-keep must cost no more than ``TRACE_OVERHEAD_CEIL_PCT`` of
+    throughput or p99 (the ``ledger-report --check-regression`` gate).
+
+    A single on/off pair at these durations measures scheduler jitter, not
+    tracing cost, so the legs run interleaved ``reps`` times and report
+    medians plus ``p99_noise_ms`` — the off leg's own max-min spread. The
+    gate only trips when the on-vs-off delta exceeds that spread: tracing
+    has to cost more than the baseline disagrees with itself."""
+    samples: Dict[str, List[Dict]] = {"off": [], "on": []}
+    kept = 0
+    for rep in range(max(1, reps)):
+        for label, tracer in (
+            ("off", None),
+            ("on", RequestTracer(TRACE_SAMPLE_RATE, anomaly_keep=True,
+                                 seed=FLEET_SEED + rep)),
+        ):
+            with mk_fleet(cache_rows=BATCH, hedge_budget_pct=0.0,
+                          request_tracer=tracer) as fleet:
+                _install_floor(fleet, SERVICE_FLOOR_MS)
+                _prewarm(fleet, capacity)
+                res = run_open_loop(
+                    lambda anchor, ids: fleet.pull(ids),
+                    qps=qps, duration_s=duration_s, seed=seed + rep,
+                    id_space=capacity, batch=BATCH, zipf_a=ZIPF_A,
+                )
+                _quiesce(fleet)
+                samples[label].append(res)
+                if tracer is not None:
+                    kept += tracer.stats()["kept"]
+
+    def med(label: str, key: str) -> float:
+        return float(statistics.median(s[key] for s in samples[label]))
+
+    qps_off, qps_on = med("off", "achieved_qps"), med("on", "achieved_qps")
+    p99_off, p99_on = med("off", "p99_ms"), med("on", "p99_ms")
+    off_p99s = [s["p99_ms"] for s in samples["off"]]
+    return {
+        "offered_qps": round(qps, 1),
+        "sample_rate": TRACE_SAMPLE_RATE,
+        "reps": max(1, reps),
+        "qps_off": qps_off,
+        "qps_on": qps_on,
+        "p99_off_ms": p99_off,
+        "p99_on_ms": p99_on,
+        "p99_off_reps": off_p99s,
+        "p99_on_reps": [s["p99_ms"] for s in samples["on"]],
+        "p99_noise_ms": round(max(off_p99s) - min(off_p99s), 3),
+        "overhead_qps_pct": round(
+            100.0 * (qps_off - qps_on) / qps_off if qps_off else 0.0, 3),
+        "overhead_p99_pct": round(
+            100.0 * (p99_on - p99_off) / p99_off if p99_off else 0.0, 3),
+        "overhead_ceil_pct": TRACE_OVERHEAD_CEIL_PCT,
+        "kept_traces": int(kept),
+    }
+
+
 def fleet_bench(
     small: bool = False,
     workdir: Optional[str] = None,
@@ -291,11 +365,11 @@ def fleet_bench(
 
         def mk_fleet(n: int = replicas, affinity: bool = True,
                      hedge_budget_pct: float = 10.0,
-                     cache_rows: int = 1024) -> Fleet:
+                     cache_rows: int = 1024, **extra) -> Fleet:
             return Fleet.from_checkpoint(
                 root, cfg, replicas=n, ledger=ledger,
                 batch_buckets=(BATCH,), cache_rows=cache_rows,
-                queue_depth=64,
+                queue_depth=64, **extra,
             ).configure(affinity=affinity,
                          hedge_budget_pct=hedge_budget_pct)
 
@@ -370,6 +444,14 @@ def fleet_bench(
                              duration_s=1.5, budget_pct=0.0,
                              stall_ms=stall_ms, seed=rng_seed + 400)
 
+        # -- tracing overhead at equal offered load ------------------------
+        # 0.6x the knee: at saturation p99 measures queueing instability,
+        # not tracing cost, and the comparison drowns in its own noise
+        trace_qps = max(min(0.6 * swept["max_qps"], 150.0), 50.0)
+        trace_overhead = _trace_overhead_leg(
+            mk_fleet, capacity=capacity, qps=trace_qps,
+            duration_s=duration_s, seed=rng_seed + 500)
+
         return {
             "seed": FLEET_SEED,
             "small": bool(small),
@@ -408,6 +490,7 @@ def fleet_bench(
                 "hedge_won": hedged["hedge_won"],
                 "hedge_rate_pct": hedged["hedge_rate_pct"],
             },
+            "trace_overhead": trace_overhead,
             "qps": swept["max_qps"],
             "p99_ms": (at_max or {}).get("p99_ms", 0.0),
             "elapsed_s": round(time.monotonic() - t_start, 2),
@@ -457,10 +540,14 @@ def fleet_chaos_drill(
             spec = ",".join(f"{kind}@{i}" for i in range(0, 60))
             plan = ChaosPlan(parse_chaos_spec(spec), seed=FLEET_SEED,
                              ledger=ledger)
+            # tail-keep only (rate 0): every hedged / re-routed / degraded
+            # request must still land in the ring as a complete span tree
+            tracer = RequestTracer(0.0, anomaly_keep=True, seed=FLEET_SEED)
             with Fleet.from_checkpoint(
                 root, cfg, replicas=2, ledger=ledger,
                 batch_buckets=(BATCH,), cache_rows=256, queue_depth=64,
                 breaker_threshold=3, breaker_cooldown_ms=400.0,
+                request_tracer=tracer,
             ).configure(hedge_budget_pct=30.0) as fleet:
                 reps = fleet.replicas()
                 for rep in reps[:-1]:
@@ -488,7 +575,37 @@ def fleet_chaos_drill(
                 availability = 100.0 - res["error_rate_pct"]
                 victim_breaker = \
                     victim.servant.breakers["pull"].snapshot()
+                # every anomaly trace must be a complete tree, and the
+                # drill's signature anomaly must be drillable end to end:
+                # a re-route hop (kill) / both hedge attempts (slow)
+                anomalies = [c.to_dict() for c in tracer.anomaly_traces()]
+                trees_ok = bool(anomalies) and all(
+                    tree_complete(t, require=("attempt", "request"))
+                    for t in anomalies)
+                if drill == "kill_replica":
+                    sig = [t for t in anomalies
+                           if "reroute" in t["anomalies"]
+                           and tree_complete(t, require=(
+                               "attempt", "reroute", "request"))]
+                else:
+                    sig = [t for t in anomalies
+                           if "hedge" in t["anomalies"]
+                           and sum(1 for s in t["spans"]
+                                   if s["name"] == "attempt") >= 2
+                           and tree_complete(t, require=(
+                               "attempt", "request"))]
+                trace_ok = trees_ok and bool(sig)
+                trace_path = os.path.join(
+                    workdir, f"fleet-{drill}-traces.json")
+                try:
+                    tracer.export_chrome(trace_path)
+                except OSError:
+                    trace_path = None
                 results[drill] = {
+                    "anomaly_traces": len(anomalies),
+                    "trace_trees_complete": trace_ok,
+                    "trace_id": sig[0]["trace_id"] if sig else None,
+                    "trace_export": trace_path,
                     "availability_pct": round(availability, 3),
                     "floor_pct": float(floor_pct),
                     "p99_ms": res["p99_ms"],
@@ -499,7 +616,7 @@ def fleet_chaos_drill(
                     "hedge_won": int(reg.counter("serve.hedge_won").value),
                     "victim": victim.id,
                     "victim_breaker_trips": victim_breaker["trips"],
-                    "recovered": availability >= floor_pct,
+                    "recovered": availability >= floor_pct and trace_ok,
                 }
         return results
     finally:

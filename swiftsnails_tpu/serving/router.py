@@ -176,6 +176,33 @@ def spill_order(
     return ordered, False, cap
 
 
+def route_annotation(
+    ordered: Sequence[str],
+    picked: Sequence[str],
+    *,
+    affinity: bool,
+    last_resort: bool = False,
+) -> Dict:
+    """The owner-vs-spill routing decision, as flat trace-annotation facts.
+
+    ``ordered`` is the pre-spill candidate order (ring successors or the
+    least-loaded spray), ``picked`` the post-spill order actually used.
+    Pure policy-to-telemetry glue: the fleet attaches the returned dict to
+    the request's trace so a re-read of one anomaly trace answers "did the
+    owner serve this, or did it spill — and to whom?".
+    """
+    owner = ordered[0] if ordered else None
+    target = picked[0] if picked else None
+    return {
+        "route_owner": owner,
+        "route_target": target,
+        "route_spilled": bool(affinity and owner is not None
+                              and target != owner),
+        "route_affinity": bool(affinity),
+        "route_last_resort": bool(last_resort),
+    }
+
+
 class EwmaQuantile:
     """EWMA-smoothed windowed quantile — the hedge timer's p95 estimate.
 

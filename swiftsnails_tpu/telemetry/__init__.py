@@ -21,6 +21,18 @@ SURVEY §5 lists glog lines and a chrono ``Timer`` as its entire surface):
 * :mod:`~swiftsnails_tpu.telemetry.blackbox` — bounded ring of the last N
   steps' spans/metrics, dumped to disk on exception, NaN/Inf loss, SIGTERM.
 
+The serving/freshness plane adds three more (docs/OBSERVABILITY.md):
+
+* :mod:`~swiftsnails_tpu.telemetry.request_trace` — request-scoped
+  distributed tracing: propagable trace/span ids, deterministic head
+  sampling plus always-keep tail sampling for anomalies, ring-buffered
+  with JSONL / Chrome-trace export;
+* :mod:`~swiftsnails_tpu.telemetry.slo` — windowed SLO tracker with
+  multi-window burn-rate alerting, error-budget accounting, and a
+  ``should_scale()`` hook, emitting ``slo_burn`` ledger events;
+* :mod:`~swiftsnails_tpu.telemetry.ops` — the one-screen fleet dashboard
+  (``python -m swiftsnails_tpu ops`` / the serve REPL's ``ops`` op).
+
 Off by default: the TrainLoop only constructs these when the ``telemetry``
 or ``trace_path`` config keys are set, and its hot path pays one
 enabled-flag check otherwise.
@@ -54,6 +66,13 @@ from swiftsnails_tpu.telemetry.ledger import (
     load_bench_cache,
     validate_bench_payload,
 )
+from swiftsnails_tpu.telemetry.ops import render_ops, render_ops_from_ledger
+from swiftsnails_tpu.telemetry.request_trace import (
+    RequestContext,
+    RequestTracer,
+    tree_complete,
+)
+from swiftsnails_tpu.telemetry.slo import SloObjective, SloTracker
 from swiftsnails_tpu.telemetry.summary import summarize_file
 from swiftsnails_tpu.telemetry.tracer import Tracer
 
@@ -63,6 +82,13 @@ from swiftsnails_tpu.utils.metrics import MetricsLogger as JsonlSink
 
 __all__ = [
     "Tracer",
+    "RequestContext",
+    "RequestTracer",
+    "SloObjective",
+    "SloTracker",
+    "tree_complete",
+    "render_ops",
+    "render_ops_from_ledger",
     "MetricRegistry",
     "Counter",
     "Gauge",

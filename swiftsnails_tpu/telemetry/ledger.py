@@ -589,7 +589,8 @@ def render_report(ledger: Ledger) -> str:
 # for context — `ledger-report --failures`
 FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
                  "retry_exhausted", "breaker", "degraded", "membership",
-                 "hedge", "drain", "freshness_gap")
+                 "hedge", "drain", "freshness_gap", "slo_burn",
+                 "trace_anomaly")
 
 
 def _failure_line(r: Dict) -> str:
@@ -689,6 +690,28 @@ def _failure_line(r: Dict) -> str:
             f"applied_seq={r.get('applied_seq')} "
             f"fallbacks={r.get('fallbacks')}"
             + (f"  {str(r.get('error', ''))[:70]}" if r.get("error") else "")
+        )
+    if kind == "slo_burn":
+        # the SLO tracker's transition-edged burn alerts (telemetry/slo.py):
+        # one line when a kernel ENTERS the alerting state, not per request
+        return (
+            f"  {ts}  SLO-BURN kernel={r.get('kernel')} "
+            f"source={r.get('source')} "
+            f"burn={r.get('burn_short')}/{r.get('burn_long')} "
+            f"(alert>={r.get('alert_burn')}) "
+            f"budget_left={r.get('budget_remaining_pct')}% "
+            f"slo={r.get('slo_latency_ms')}ms@{r.get('slo_availability')}"
+        )
+    if kind == "trace_anomaly":
+        # the request tracer's rate-limited anomaly stream (first + every
+        # 100th kept anomaly trace) — each line names a drillable trace_id
+        kinds = r.get("anomalies")
+        return (
+            f"  {ts}  TRACE-ANOMALY kernel={r.get('kernel')} "
+            f"trace={r.get('trace_id')} "
+            f"kinds={','.join(kinds) if isinstance(kinds, list) else kinds} "
+            f"dur={_fmt_num(r.get('dur_ms', 0))}ms "
+            f"total={r.get('anomalies_total')}"
         )
     if kind == "membership":
         # the cluster supervisor's lifecycle timeline (cluster/supervisor.py)
@@ -840,8 +863,12 @@ def check_regression(
         n_rc, n_msg = _check_freshness_regression(ledger)
         if n_msg:
             msg = f"{msg}\n{n_msg}"
+        o_rc, o_msg = _check_trace_overhead_regression(ledger)
+        if o_msg:
+            msg = f"{msg}\n{o_msg}"
         return max(
-            2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc), msg
+            2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
+            o_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -878,8 +905,12 @@ def check_regression(
             n_rc, n_msg = _check_freshness_regression(ledger)
             if n_msg:
                 msg = f"{msg}\n{n_msg}"
+            o_rc, o_msg = _check_trace_overhead_regression(ledger)
+            if o_msg:
+                msg = f"{msg}\n{o_msg}"
             return max(
-                0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc), msg
+                0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
+                o_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -923,8 +954,12 @@ def check_regression(
     n_rc, n_msg = _check_freshness_regression(ledger)
     if n_msg:
         msg = f"{msg}\n{n_msg}"
+    o_rc, o_msg = _check_trace_overhead_regression(ledger)
+    if o_msg:
+        msg = f"{msg}\n{o_msg}"
     return max(
-        rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc), msg
+        rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
+        o_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -1403,6 +1438,63 @@ def _check_fleet_regression(
         f"fleet ok: {qps:,.1f} qps (scaling {scaling}x >= {floor_x}x, "
         f"p99 {p99}ms <= SLO {slo}ms) vs qps baseline {max(earlier):,.1f} "
         f"({platform or '?'})"
+    )
+
+
+def _trace_overhead_values(record: Dict) -> Optional[Dict]:
+    """The ``trace_overhead`` block from a bench payload's ``fleet`` block
+    (the fleet lane's tracing on-vs-off ride-along), or None when the leg
+    didn't run in that record."""
+    fb = record.get("payload", {}).get("fleet")
+    if not isinstance(fb, dict):
+        return None
+    to = fb.get("trace_overhead")
+    if not isinstance(to, dict):
+        return None
+    q, p = to.get("overhead_qps_pct"), to.get("overhead_p99_pct")
+    if not (isinstance(q, (int, float)) and isinstance(p, (int, float))):
+        return None
+    return to
+
+
+def _check_trace_overhead_regression(
+    ledger: Ledger,
+) -> Tuple[int, Optional[str]]:
+    """Gate the observability plane's own cost: in the newest bench record
+    carrying the fleet lane's ``trace_overhead`` leg, tracing on (head
+    sampling + tail-keep) vs off at equal offered load must cost no more
+    than the leg's ceiling (3%) of throughput or p99. The p99 comparison
+    carries a noise floor: 1ms, widened to the off leg's own max-min
+    spread across its repetitions (``p99_noise_ms``) when the leg ships
+    one — a delta inside the baseline's self-disagreement is scheduler
+    jitter, not tracing cost. Same-platform comparison is free here (both
+    legs run in the same process); no history gates nothing."""
+    with_to = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict) and _trace_overhead_values(r)
+    ]
+    if not with_to:
+        return 0, None
+    to = _trace_overhead_values(with_to[-1])
+    ceil = float(to.get("overhead_ceil_pct", 3.0) or 3.0)
+    q = float(to["overhead_qps_pct"])
+    p99_off = float(to.get("p99_off_ms") or 0.0)
+    p99_on = float(to.get("p99_on_ms") or 0.0)
+    problems = []
+    if q > ceil:
+        problems.append(
+            f"tracing costs {q:.2f}% of throughput at equal offered load "
+            f"(ceiling {ceil}%)")
+    noise = float(to.get("p99_noise_ms") or 0.0)
+    if (p99_on - p99_off) > max(ceil / 100.0 * p99_off, 1.0, noise):
+        problems.append(
+            f"tracing p99 {p99_on}ms vs {p99_off}ms off exceeds the "
+            f"{ceil}% ceiling (noise floor {max(1.0, noise):.1f}ms)")
+    if problems:
+        return 1, "trace-overhead REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"trace-overhead ok: qps {q:+.2f}%, p99 {p99_off}->{p99_on}ms "
+        f"at sample rate {to.get('sample_rate')} (ceiling {ceil}%)"
     )
 
 

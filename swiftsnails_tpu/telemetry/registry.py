@@ -58,9 +58,17 @@ class Histogram:
 
     Keeps exact count/sum/min/max plus a bounded window of recent samples for
     percentiles — enough for per-window records without unbounded memory.
+
+    **Exemplars.** ``observe(value, trace_id=...)`` remembers a small window
+    of traced observations; :meth:`summary` reports the slowest of them as
+    ``exemplar_value`` / ``exemplar_trace_id``, so the ``p99`` in any report
+    links to one concrete request trace instead of an anonymous aggregate.
+    Only pass ids of traces that will actually be *kept* (head-sampled or
+    anomalous), or the link dangles.
     """
 
-    __slots__ = ("name", "count", "total", "min", "max", "_recent", "_lock")
+    __slots__ = ("name", "count", "total", "min", "max", "_recent",
+                 "_traced", "_lock")
 
     def __init__(self, name: str, window: int = 512):
         self.name = name
@@ -69,9 +77,10 @@ class Histogram:
         self.min = float("inf")
         self.max = float("-inf")
         self._recent: Deque[float] = deque(maxlen=window)
+        self._traced: Deque = deque(maxlen=8)  # (value, trace_id) exemplars
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         value = float(value)
         with self._lock:
             self.count += 1
@@ -81,6 +90,16 @@ class Histogram:
             if value > self.max:
                 self.max = value
             self._recent.append(value)
+            if trace_id is not None:
+                self._traced.append((value, trace_id))
+
+    def exemplar(self) -> Optional[Dict[str, float]]:
+        """The slowest recent traced observation (tail exemplar), if any."""
+        with self._lock:
+            if not self._traced:
+                return None
+            value, trace_id = max(self._traced, key=lambda vt: vt[0])
+        return {"value": value, "trace_id": trace_id}
 
     def summary(self) -> Dict[str, float]:
         with self._lock:
@@ -88,7 +107,7 @@ class Histogram:
                 return {"count": 0}
             recent = sorted(self._recent)
             q = lambda p: recent[min(int(p * (len(recent) - 1)), len(recent) - 1)]
-            return {
+            out = {
                 "count": self.count,
                 "sum": self.total,
                 "mean": self.total / self.count,
@@ -98,6 +117,11 @@ class Histogram:
                 "p95": q(0.95),
                 "p99": q(0.99),
             }
+            if self._traced:
+                value, trace_id = max(self._traced, key=lambda vt: vt[0])
+                out["exemplar_value"] = value
+                out["exemplar_trace_id"] = trace_id
+            return out
 
 
 class StdoutSummarySink:

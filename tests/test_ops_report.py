@@ -1,0 +1,186 @@
+"""Ops dashboard: live rendering from fleet/servant stats + health shapes,
+the ledger-reconstructed offline view, and the ``ops`` CLI plumbing
+(``python -m swiftsnails_tpu ops`` / ``tools/ops_report.py``)."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.cli import main as cli_main
+from swiftsnails_tpu.serving import Servant
+from swiftsnails_tpu.serving.fleet import Fleet
+from swiftsnails_tpu.telemetry.ledger import Ledger
+from swiftsnails_tpu.telemetry.ops import render_ops, render_ops_from_ledger
+from swiftsnails_tpu.telemetry.request_trace import RequestTracer
+from swiftsnails_tpu.telemetry.slo import SloObjective, SloTracker
+
+
+# ----------------------------------------------------------- live view ----
+
+
+def test_render_ops_live_fleet_one_screen():
+    table = np.random.default_rng(0).standard_normal((64, 8)).astype("f4")
+    tracer = RequestTracer(1.0, seed=0)
+    slo = SloTracker({"pull": SloObjective(50.0)})
+    fleet = Fleet(lambda rid: Servant({"t": table}, batch_buckets=(8,)),
+                  replicas=2, request_tracer=tracer, slo=slo)
+    with fleet:
+        for k in range(8):
+            fleet.pull([k], key=k)
+        out = render_ops(fleet.stats(), health=fleet.health(),
+                        anomalies=[c.to_dict()
+                                   for c in tracer.anomaly_traces(5)])
+    assert out.startswith("fleet: status=ok replicas=2")
+    assert "r0" in out and "r1" in out  # per-replica rows
+    assert "breakers" in out and "hit" in out
+    assert "slo:" in out and "pull" in out  # the SLO table rendered
+    assert "freshness: (not subscribed)" in out
+    assert "traces: started=8" in out
+    # one screen means one screen
+    assert len(out.splitlines()) < 40
+
+
+def test_render_ops_live_servant_and_unconfigured_states():
+    out = render_ops(
+        {"kernels": {"pull": {"p99_ms": 2.0, "count": 10}},
+         "cache": {"hit_rate": 0.5}},
+        health={"status": "ok"})
+    assert out.startswith("servant: status=ok")
+    assert "slo: (not configured" in out
+    assert "traces: (tracing off" in out
+    assert "freshness: (not subscribed)" in out
+
+
+def test_render_ops_surfaces_anomaly_trace_ids_and_breakers():
+    stats = {
+        "replicas": {
+            "r0": {"state": "active", "requests": 12,
+                   "cache_hit_rate": 0.9,
+                   "kernels": {"pull": {"p50_ms": 1.0, "p99_ms": 4.0}},
+                   "breakers": {"pull": "open"}},
+        },
+        "reroutes": 1, "spills": 0,
+        "slo": {"pull": {"slo_latency_ms": 10.0, "slo_availability": 0.999,
+                         "burn_short": 3.0, "burn_long": 2.5,
+                         "budget_remaining_pct": 10.0, "alerting": True}},
+        "trace": {"started": 5, "kept": 2, "anomalies": 1, "ring": 2,
+                  "sample_rate": 0.1},
+    }
+    anomalies = [{"trace_id": "feedfacefeedface", "kernel": "pull",
+                  "dur_ms": 33.1, "anomalies": ["reroute"]}]
+    out = render_ops(stats, health={"status": "degraded"},
+                     anomalies=anomalies)
+    assert "status=degraded" in out
+    assert "pull:open" in out  # the open breaker is named, not counted
+    assert "ALERTING" in out
+    assert "feedfacefeedface" in out and "reroute" in out
+
+
+# --------------------------------------------------------- ledger view ----
+
+
+def _seed_ledger(path):
+    led = Ledger(path)
+    led.append("bench", {"payload": {
+        "metric": "word2vec_words_per_sec_per_chip", "value": 1.0,
+        "unit": "words/sec/chip", "platform": "cpu", "config": {},
+        "fleet": {
+            "qps": 310.0, "p99_ms": 22.0, "scaling_x": 1.9,
+            "scaling_floor": 1.6,
+            "fleet": {"per_replica": {
+                "r0": {"requests": 400, "qps": 200.0, "p50_ms": 1.0,
+                       "p99_ms": 4.0, "cache_hit_rate": 0.91},
+                "r1": {"requests": 380, "qps": 190.0, "p50_ms": 1.1,
+                       "p99_ms": 4.4, "cache_hit_rate": 0.88},
+            }},
+            "trace_overhead": {"overhead_qps_pct": 0.7,
+                               "overhead_p99_pct": 1.2,
+                               "overhead_ceil_pct": 3.0,
+                               "sample_rate": 0.1},
+        },
+        "freshness": {"lag_p99_ms": 40.0, "lag_ceiling_ms": 250.0,
+                      "bit_parity": 0.0, "gap_drill": {"recovered": True}},
+    }})
+    led.append("slo_burn", {
+        "source": "fleet", "kernel": "pull", "burn_short": 4.0,
+        "burn_long": 2.2, "alert_burn": 2.0, "budget_remaining_pct": 61.5,
+        "slo_latency_ms": 10.0, "slo_availability": 0.999, "window_s": 60.0,
+    })
+    led.append("trace_anomaly", {
+        "source": "freshness", "trace_id": "0badc0de0badc0de",
+        "kernel": "delta_fallback", "anomalies": ["fallback"],
+        "dur_ms": 120.5, "anomalies_total": 1,
+    })
+    led.append("freshness_gap", {
+        "source": "freshness", "reason": "missing_seq", "phase": "apply",
+    })
+    return led
+
+
+def test_render_ops_from_ledger_reconstructs_the_screen(tmp_path):
+    led = _seed_ledger(str(tmp_path / "l.jsonl"))
+    out = render_ops_from_ledger(led)
+    assert out.startswith("ops report:")
+    assert "max_qps=310.0" in out and "scaling=1.9x" in out
+    assert "r0" in out and "r1" in out and "200.0/s" in out
+    assert "trace overhead: qps 0.70%" in out and "ceiling 3%" in out
+    assert "freshness lane: lag_p99=40.0ms" in out
+    assert "gap_recovered=True" in out
+    assert "error budget: 61.5% left on pull" in out
+    assert "0badc0de0badc0de" in out and "fallback" in out
+    assert "freshness gaps: 1 events" in out
+    assert "reason=missing_seq" in out
+
+
+def test_render_ops_from_ledger_empty_sections(tmp_path):
+    led = Ledger(str(tmp_path / "empty.jsonl"))
+    led.append("bench", {"payload": {
+        "metric": "m", "value": 1.0, "unit": "u", "platform": "cpu",
+        "config": {}}})
+    out = render_ops_from_ledger(led)
+    assert "fleet lane: (no fleet bench record)" in out
+    assert "freshness lane: (no freshness bench record)" in out
+    assert "error budget: (no slo_burn events)" in out
+    assert "anomaly traces: (none ledgered)" in out
+
+
+# ----------------------------------------------------------------- CLI ----
+
+
+def test_ops_cli_renders_and_exits_clean(tmp_path, capsys):
+    path = str(tmp_path / "l.jsonl")
+    _seed_ledger(path)
+    assert cli_main(["ops", path]) == 0
+    out = capsys.readouterr().out
+    assert "ops report:" in out and "error budget" in out
+
+
+def test_ops_cli_missing_ledger_fails(tmp_path, capsys):
+    missing = str(tmp_path / "nope.jsonl")
+    assert cli_main(["ops", missing]) == 1
+    assert "no ledger" in capsys.readouterr().err
+
+
+def test_ops_is_a_known_command(capsys):
+    assert cli_main(["definitely-not-a-command"]) == 2
+    err = capsys.readouterr().err
+    assert "ops" in err  # advertised in the try-these list
+    assert cli_main(["--help"]) == 0
+    assert "ops [LEDGER.jsonl]" in capsys.readouterr().out
+
+
+def test_tools_wrapper_runs(tmp_path):
+    import subprocess
+
+    path = str(tmp_path / "l.jsonl")
+    _seed_ledger(path)
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "ops_report.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, tool, path],
+                          capture_output=True, text=True, env=env)
+    assert proc.returncode == 0
+    assert "ops report:" in proc.stdout and "error budget" in proc.stdout

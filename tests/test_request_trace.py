@@ -1,0 +1,303 @@
+"""Request-scoped tracing: deterministic head sampling, anomaly tail-keep,
+context propagation across the fleet's re-route and hedge paths and the
+freshness wire, span-tree completeness, and histogram exemplars.
+
+The sampling contract (ISSUE 16): the keep/drop decision is a pure
+function of the trace id, so any two processes that see the same id —
+the delta publisher and every subscriber, or a future RPC hop — agree
+with no coordination; and a request that turned out *interesting*
+(typed failure, hedge, re-route, degraded, fallback, shed, SLO breach)
+is kept regardless of the sampling dice.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.serving import Overloaded, Servant
+from swiftsnails_tpu.serving.fleet import Fleet
+from swiftsnails_tpu.serving.router import route_hash
+from swiftsnails_tpu.telemetry.ledger import Ledger
+from swiftsnails_tpu.telemetry.registry import Histogram
+from swiftsnails_tpu.telemetry.request_trace import (
+    RequestContext,
+    RequestTracer,
+    tree_complete,
+)
+from swiftsnails_tpu.utils.config import Config
+
+DIM = 8
+CAP = 64
+
+
+def _table(cap=CAP, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((cap, DIM)).astype(np.float32)
+
+
+def _mk_fleet(n=2, *, cap=CAP, ledger=None, **fleet_kw):
+    table = _table(cap)
+
+    def factory(rid):
+        return Servant({"t": table}, batch_buckets=(8,), cache_rows=64)
+
+    return table, Fleet(factory, replicas=n, ledger=ledger, **fleet_kw)
+
+
+def _owned_key(fleet, rid, lo=0, hi=CAP):
+    for k in range(lo, hi):
+        if fleet._ring.successors(route_hash(k))[0] == rid:
+            return k
+    raise AssertionError(f"no key in [{lo}, {hi}) owned by {rid}")
+
+
+# ------------------------------------------------------- head sampling ----
+
+
+def test_head_sampling_is_deterministic_per_id():
+    a = RequestTracer(0.25, seed=7)
+    b = RequestTracer(0.25, seed=99)  # different mint seed, same policy
+    ids = [a._mint_id() for _ in range(512)]
+    # pure function of the id: a second tracer with the same rate agrees
+    # on every single id, no shared state required
+    assert [a.head_sampled(i) for i in ids] == \
+           [b.head_sampled(i) for i in ids]
+    # and the rate is actually in the neighborhood asked for
+    frac = sum(a.head_sampled(i) for i in ids) / len(ids)
+    assert 0.12 < frac < 0.40
+    # edges: 0 samples nothing, 1 samples everything, garbage never keeps
+    assert not RequestTracer(0.0).head_sampled(ids[0])
+    assert RequestTracer(1.0).head_sampled(ids[0])
+    assert not RequestTracer(0.5).head_sampled("not-hex")
+
+
+def test_minted_ids_are_seed_deterministic():
+    ids1 = [RequestTracer(0.1, seed=3)._mint_id() for _ in range(5)]
+    ids2 = [RequestTracer(0.1, seed=3)._mint_id() for _ in range(5)]
+    ids3 = [RequestTracer(0.1, seed=4)._mint_id() for _ in range(5)]
+    assert ids1 == ids2  # same seed -> same id sequence (drill replay)
+    assert ids1 != ids3
+    assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids1)
+
+
+def test_anomaly_tail_keep_beats_the_sampling_dice():
+    rt = RequestTracer(0.0, anomaly_keep=True, seed=1)
+    boring = rt.start("pull")
+    assert not rt.finish(boring)  # rate 0, nothing interesting: dropped
+    spicy = rt.start("pull")
+    spicy.mark_anomaly("reroute")
+    assert rt.finish(spicy)  # kept despite rate 0
+    assert [c.trace_id for c in rt.traces()] == [spicy.trace_id]
+    assert rt.get(spicy.trace_id) is spicy
+    assert rt.stats()["anomalies"] == 1 and rt.stats()["dropped"] == 1
+    # tail-keep off: even an anomalous trace obeys the dice
+    off = RequestTracer(0.0, anomaly_keep=False)
+    ctx = off.start("pull")
+    ctx.mark_anomaly("hedge")
+    assert not off.finish(ctx)
+
+
+def test_slo_violation_automarked_on_finish():
+    t = [0]
+    rt = RequestTracer(0.0, anomaly_keep=True, slo_ms=5.0,
+                       clock_ns=lambda: t[0])
+    ctx = rt.start("pull")
+    t[0] = 6_000_000  # 6 ms > the 5 ms objective
+    assert rt.finish(ctx)
+    assert "slo_violation" in ctx.anomalies
+    fast = rt.start("pull")
+    t[0] += 1_000_000
+    assert not rt.finish(fast)
+
+
+def test_from_config_gates_and_defaults():
+    assert RequestTracer.from_config(Config({})) is None
+    rt = RequestTracer.from_config(Config({"trace_sample_rate": "0.5",
+                                           "slo_latency_ms": "12"}))
+    assert rt.sample_rate == 0.5 and rt.anomaly_keep and rt.slo_ms == 12.0
+    # tail-keep alone works at rate 0
+    keep_only = RequestTracer.from_config(
+        Config({"trace_anomaly_keep": "1"}))
+    assert keep_only is not None and keep_only.sample_rate == 0.0
+    # explicitly off
+    assert RequestTracer.from_config(
+        Config({"trace_sample_rate": "0", "trace_anomaly_keep": "0"})) is None
+
+
+# ---------------------------------------------------------- propagation ----
+
+
+def test_wire_resume_stitches_tree_and_agrees_on_sampling():
+    pub = RequestTracer(1.0, seed=5)
+    sub = RequestTracer(1.0, seed=77)  # a different process, same policy
+    ctx = pub.start("delta_publish", publisher="p0")
+    with ctx.span("write"):
+        pass
+    wire = ctx.wire()
+    assert wire["trace_id"] == ctx.trace_id
+    pub.finish(ctx)
+    far = sub.resume(wire, "delta_apply")
+    assert far.trace_id == ctx.trace_id  # one trace across the wire
+    assert far.resumed and far.sampled == ctx.sampled
+    assert far.baggage["publisher"] == "p0"  # baggage rode along
+    assert far.root_span_id == wire["span_id"]  # stitched, not re-rooted
+    sub.finish(far)
+    # garbled / absent wire falls back to a fresh trace, never raises
+    fresh = sub.resume(None, "delta_apply")
+    assert fresh.trace_id != ctx.trace_id and not fresh.resumed
+    garbled = sub.resume({"trace_id": 42, "span_id": "x"}, "delta_apply")
+    assert not garbled.resumed
+
+
+def test_fleet_reroute_yields_complete_anomaly_trace():
+    tracer = RequestTracer(0.0, anomaly_keep=True, seed=0)
+    table, fleet = _mk_fleet(2, hedge_budget_pct=0.0,
+                             request_tracer=tracer)
+    with fleet:
+        reps = {r.id: r for r in fleet.replicas()}
+        key = _owned_key(fleet, "r0")
+
+        def sick(kernel):
+            raise Overloaded("synthetic queue-full")
+
+        reps["r0"].request_hook = sick
+        got = fleet.pull([key], key=key)
+        np.testing.assert_array_equal(got, table[[key]])
+    anoms = [c.to_dict() for c in tracer.anomaly_traces()]
+    assert len(anoms) == 1
+    t = anoms[0]
+    assert "reroute" in t["anomalies"]
+    assert tree_complete(t, require=("attempt", "reroute", "request"))
+    # the sick attempt and the rescuing hop are both in the tree, and the
+    # route decision is an annotation, not archaeology
+    attempts = [s for s in t["spans"] if s["name"] == "attempt"]
+    assert {a["args"]["replica"] for a in attempts} == {"r0"}
+    hop = next(s for s in t["spans"] if s["name"] == "reroute")
+    assert hop["args"] == {"replica": "r1", "outcome": "won"}
+    assert t["annotations"]["route_owner"] == "r0"
+    assert t["annotations"]["winner"] == "r1"
+    assert t["annotations"]["rerouted"] is True
+
+
+def test_fleet_hedge_legs_nest_under_one_root():
+    tracer = RequestTracer(0.0, anomaly_keep=True, seed=0)
+    table, fleet = _mk_fleet(2, hedge_budget_pct=100.0, hedge_p95_ms=15.0,
+                             request_tracer=tracer)
+    with fleet:
+        reps = {r.id: r for r in fleet.replicas()}
+        key = _owned_key(fleet, "r0")
+        release = threading.Event()
+        reps["r0"].request_hook = lambda kernel: release.wait(10)
+        got = fleet.pull([key], key=key)  # primary parked: hedge answers
+        release.set()
+        np.testing.assert_array_equal(got, table[[key]])
+        # let the parked primary leg land its span before reading the tree
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            traces = [c.to_dict() for c in tracer.anomaly_traces()]
+            if traces and len([s for s in traces[0]["spans"]
+                               if s["name"] == "attempt"]) >= 2:
+                break
+            time.sleep(0.01)
+    assert traces and "hedge" in traces[0]["anomalies"]
+    t = traces[0]
+    assert tree_complete(t, require=("attempt", "request"))
+    attempts = [s for s in t["spans"] if s["name"] == "attempt"]
+    assert len(attempts) >= 2  # both racing legs captured
+    outcomes = {a["args"]["replica"]: a["args"].get("outcome")
+                for a in attempts}
+    assert outcomes.get("r1") == "won"  # first writer wins, and it shows
+
+
+# ------------------------------------------------------ capture bounds ----
+
+
+def test_span_capture_is_bounded():
+    rt = RequestTracer(1.0, max_spans=4)
+    ctx = rt.start("pull")
+    for i in range(10):
+        with ctx.span("step", i=i):
+            pass
+    rt.finish(ctx)
+    d = ctx.to_dict()
+    # 4 recorded + the root "request" span could not land (ring full):
+    # dropped accounting tells on the truncation instead of lying
+    assert len(d["spans"]) == 4
+    assert d["dropped_spans"] == 7
+
+
+def test_exports_round_trip(tmp_path):
+    rt = RequestTracer(1.0, seed=2)
+    ctx = rt.start("pull", client="bench")
+    with ctx.span("queue_wait"):
+        pass
+    ctx.annotate(cache_hits=3)
+    rt.finish(ctx)
+    jl = str(tmp_path / "traces.jsonl")
+    assert rt.export_jsonl(jl) == 1
+    rec = json.loads(open(jl).read().strip())
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["annotations"]["cache_hits"] == 3
+    assert tree_complete(rec, require=("queue_wait",))
+    doc = rt.chrome_trace()
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in evs} == {"queue_wait", "request"}
+    assert all(e["args"]["trace_id"] == ctx.trace_id for e in evs)
+    cj = str(tmp_path / "traces.json")
+    rt.export_chrome(cj)
+    assert "traceEvents" in json.load(open(cj))
+
+
+def test_tree_complete_rejects_orphans_and_missing_names():
+    ok = {"spans": [
+        {"name": "request", "span_id": 1, "parent": 0},
+        {"name": "attempt", "span_id": 2, "parent": 1},
+    ]}
+    assert tree_complete(ok)
+    assert tree_complete(ok, require=("attempt",))
+    assert not tree_complete(ok, require=("reroute",))  # name missing
+    orphan = {"spans": [
+        {"name": "request", "span_id": 1, "parent": 0},
+        {"name": "attempt", "span_id": 2, "parent": 9},  # parent vanished
+    ]}
+    assert not tree_complete(orphan)
+    assert not tree_complete({"spans": [
+        {"name": "attempt", "span_id": 2, "parent": 1}]})  # no root
+
+
+def test_trace_anomaly_ledger_stream_is_rate_limited(tmp_path):
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    rt = RequestTracer(0.0, anomaly_keep=True, ledger=led, source="fleet")
+    for _ in range(150):
+        ctx = rt.start("pull")
+        ctx.mark_anomaly("shed")
+        rt.finish(ctx)
+    evs = led.records("trace_anomaly")
+    # first + every 100th, not one line per shed request
+    assert [e["anomalies_total"] for e in evs] == [1, 100]
+    assert evs[0]["source"] == "fleet" and evs[0]["anomalies"] == ["shed"]
+
+
+# ------------------------------------------------------------ exemplars ----
+
+
+def test_histogram_exemplars_link_tail_to_traces():
+    h = Histogram("serve.pull_ms")
+    h.observe(1.0)
+    h.observe(50.0, trace_id="aabb00112233")  # the tail outlier, traced
+    s = h.summary()
+    assert s["exemplar_trace_id"] == "aabb00112233"
+    assert s["exemplar_value"] == 50.0
+    assert h.exemplar() == {"value": 50.0, "trace_id": "aabb00112233"}
+    # untraced-only histograms stay exemplar-free (old summary shape)
+    bare = Histogram("serve.topk_ms")
+    bare.observe(2.0)
+    assert "exemplar_value" not in bare.summary()
+    assert bare.exemplar() is None

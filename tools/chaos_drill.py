@@ -33,7 +33,12 @@ replica of a 2-replica :class:`Fleet` gets sick mid-storm — killed with
 ``serve_io_error`` dispatch faults (its breakers trip and the router walks
 around it) or slowed with ``serve_slow`` stalls (tail hedges rescue the
 stragglers) — and the fleet must hold the availability floor through
-breaker-aware re-routing + hedging. Exit is nonzero on a missed floor.
+breaker-aware re-routing + hedging. Each drill also runs with the request
+tracer in anomaly-keep mode and asserts a *complete trace tree* for the
+signature anomaly (re-routed requests must show attempt→reroute→attempt
+under one root; hedged requests both racing attempts) — a recovery whose
+causality can't be reconstructed counts as unrecovered. Exit is nonzero
+on a missed floor or a broken trace tree.
 
 ``--freshness`` runs the CPU-valid delta-pipeline drill matrix instead: a
 live 2-replica fleet subscribed to a hot-row delta log loses its publisher
@@ -41,7 +46,9 @@ mid-stream (a new incarnation takes over), reads a bit-flipped delta batch
 (CRC), and hits a deleted segment (sequence gap) — each drill must fall
 back to a full checkpoint reload, resubscribe past the fault, and end with
 every replica on one shared version and parity 0.0 against the reference
-planes. Exit is nonzero on any unrecovered drill.
+planes — plus a complete ``delta_fallback`` anomaly trace
+(detect→reload→resubscribe timeline) proving the recovery is
+reconstructable by trace id. Exit is nonzero on any unrecovered drill.
 
 ``--cluster`` runs the CPU-valid membership drill matrix instead (the bench
 ``chaos-cluster`` lane, one fault kind per drill): a simulated virtual-clock
@@ -122,9 +129,15 @@ def _fleet_matrix(args) -> int:
                 f"reroutes={res['reroutes']} "
                 f"hedged={res['hedged']} hedge_won={res['hedge_won']} "
                 f"victim={res['victim']} "
-                f"breaker_trips={res['victim_breaker_trips']}"
+                f"breaker_trips={res['victim_breaker_trips']} "
+                f"anomaly_traces={res.get('anomaly_traces')} "
+                f"trees_complete={res.get('trace_trees_complete')}"
             )
             print(f"{name:<{width}}  {status:<11}  {detail}")
+            if res.get("trace_id"):
+                print(f"{'':<{width}}  {'':<11}  "
+                      f"drill trace: {res['trace_id']} "
+                      f"({res.get('trace_export')})")
         print(
             f"{len(results) - len(failed)}/{len(results)} drills recovered"
             + (f"; FAILED: {', '.join(failed)}" if failed else "")
@@ -147,9 +160,13 @@ def _freshness_matrix(args) -> int:
             detail = (
                 f"fallbacks={res['fallbacks']} "
                 f"parity={res['parity']} "
-                f"applied_seq={res['applied_seq']}"
+                f"applied_seq={res['applied_seq']} "
+                f"fallback_traces={res.get('fallback_traces')}"
             )
             print(f"{name:<{width}}  {status:<11}  {detail}")
+            if res.get("trace_id"):
+                print(f"{'':<{width}}  {'':<11}  "
+                      f"fallback trace: {res['trace_id']}")
         print(
             f"{len(results) - len(failed)}/{len(results)} drills recovered"
             + (f"; FAILED: {', '.join(failed)}" if failed else "")
