@@ -134,7 +134,9 @@ _state = {
     "chaos_serve": None,  # serving availability drill (dict; --lane chaos-serve)
     "chaos_cluster": None,  # cluster membership drill (dict; --lane chaos-cluster)
     "freshness": None,  # trainer->fleet delta pipeline lane (dict; --lane freshness)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness)
+    "drift": None,  # training-plane drift drill (dict; --lane drift)
+    "profile_overhead": None,  # continuous profiler on-vs-off cost (--lane drift)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness | drift)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -247,6 +249,8 @@ def _result_json(extra_error=None):
             "chaos_serve": _state["chaos_serve"],
             "chaos_cluster": _state["chaos_cluster"],
             "freshness": _state["freshness"],
+            "drift": _state["drift"],
+            "profile_overhead": _state["profile_overhead"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1665,6 +1669,76 @@ def run_freshness_lane() -> int:
     return 0 if ok else 1
 
 
+# -- training-plane drift drill + profiler-overhead lane -----------------------
+#
+# `--lane drift` runs the observability drill (`swiftsnails_tpu/telemetry/
+# drift_lane.py`): a control run and a `slow_step@A-B` chaos run share one
+# ledger; the run's own EWMA/CUSUM sentinel must confirm the injected
+# slow-step within the window, emit exactly one transition-edged `drift`
+# ledger event, leave a complete incident bundle behind, and the
+# before/after `--diff` attribution must name host-blocked dominant. The
+# ride-along leg measures the continuous profiler's own words/sec cost
+# (sampler + sentinel on vs off at equal work) against the 3% ceiling.
+# Correctness is platform-independent, so the lane is valid on CPU; the
+# blocks land in the result JSON (`drift`, `profile_overhead`), the run
+# ledger, and the `ledger-report --check-regression` gate.
+
+
+def measure_drift() -> None:
+    """Populate ``_state['drift']`` / ``_state['profile_overhead']``."""
+    from swiftsnails_tpu.telemetry.drift_lane import drift_bench
+
+    block = drift_bench(small=_SMALL)
+    _state["drift"] = block["drift"]
+    _state["profile_overhead"] = block["profile_overhead"]
+    d, po = block["drift"], block["profile_overhead"]
+    print(
+        f"bench: drift lane: detected={d.get('detected')} "
+        f"(inject {d.get('inject_step')}, confirm {d.get('detect_step')}) "
+        f"events={d.get('drift_events')} "
+        f"bundle_complete={d.get('bundle_complete')} "
+        f"dominant={(d.get('attribution') or {}).get('dominant')} "
+        f"profiler overhead {po.get('overhead_pct')}% "
+        f"(ceiling {po.get('overhead_ceil_pct')}%, "
+        f"noise {po.get('noise_pct')}%)",
+        file=sys.stderr,
+    )
+
+
+def run_drift_lane() -> int:
+    """``--lane drift``: the drift drill + profiler-overhead leg alone."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "drift"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_drift()
+    except Exception as e:
+        _state["errors"].append(
+            f"drift lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    d, po = _state["drift"], _state["profile_overhead"]
+    # correctness lane: no perf headline — gate on the drill's own criteria
+    # (mirrored by _check_drift_regression / _check_profiler_overhead_...)
+    _state["best_path"] = "drift"
+    _save_last_good()
+    _emit_once()
+    ok = (
+        d.get("detected")
+        and d.get("drift_events") == 1
+        and d.get("bundle_complete")
+        and (d.get("attribution") or {}).get("dominant") == "host_blocked"
+        and isinstance(po.get("overhead_pct"), (int, float))
+        and po["overhead_pct"] <= max(
+            po.get("overhead_ceil_pct") or 3.0, po.get("noise_pct") or 0.0)
+    )
+    return 0 if ok else 1
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -2019,7 +2093,7 @@ def main(argv=None):
     parser.add_argument(
         "--lane",
         choices=("full", "scaling", "chaos", "serve", "fleet", "tiered",
-                 "chaos-serve", "chaos-cluster", "freshness"),
+                 "chaos-serve", "chaos-cluster", "freshness", "drift"),
         default="full",
         help="full = the headline bench (default); scaling = the scale-out "
              "lane alone (grouped-mesh 1-vs-N throughput per comm_dtype plus "
@@ -2043,7 +2117,11 @@ def main(argv=None):
              "freshness = the trainer->fleet delta pipeline lane (hot-row "
              "delta publish/apply under load: bit parity at the watermark, "
              "lag p99, serve p99 while applying, forced-gap fallback; "
-             "valid on CPU)",
+             "valid on CPU); drift = the training-plane drift drill "
+             "(slow_step injection vs the online EWMA/CUSUM sentinel: "
+             "detection + one drift event + complete incident bundle + "
+             "host-blocked --diff attribution, plus the continuous "
+             "profiler's own overhead vs the 3% ceiling; valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -2065,6 +2143,8 @@ def main(argv=None):
         return run_chaos_cluster_lane()
     if args.lane == "freshness":
         return run_freshness_lane()
+    if args.lane == "drift":
+        return run_drift_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

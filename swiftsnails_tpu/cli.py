@@ -20,6 +20,7 @@ Usage::
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
     python -m swiftsnails_tpu ledger-report --check-regression 10   # bench gate
     python -m swiftsnails_tpu ledger-report --failures   # outage/chaos timeline
+    python -m swiftsnails_tpu ledger-report --diff A B   # attribute a words/sec delta
     python -m swiftsnails_tpu supervisor-status [LEDGER.jsonl]   # membership view
     python -m swiftsnails_tpu ops [LEDGER.jsonl]   # one-screen fleet dashboard
     python -m swiftsnails_tpu worker -config ...   # alias of train (parity)

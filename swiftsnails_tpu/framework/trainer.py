@@ -29,7 +29,7 @@ import signal
 import sys
 import threading
 import time
-from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -356,11 +356,50 @@ class TrainLoop:
             # goodput needs one compile-only audit of the step function; a
             # second lowering of the same shapes, so gateable independently
             self._want_audit = cfg.get_bool("goodput", True)
+            # continuous profiling: a bounded ring of periodic metric samples
+            # (`profile_cadence` steps, 0 = off) — the registry snapshot plus
+            # the per-window goodput decomposition, tier breakdown, and
+            # comm-audit bytes; exportable as JSONL and summarized into the
+            # run record for sparklines
+            self.profile_cadence = cfg.get_int("profile_cadence", 0)
+            if self.profile_cadence > 0:
+                from swiftsnails_tpu.telemetry.timeseries import TimeSeriesStore
+
+                self.timeseries = TimeSeriesStore(
+                    window=cfg.get_int("profile_window", 512))
+            else:
+                self.timeseries = None
+            # drift sentinel: EWMA/CUSUM detectors over the sampled signals;
+            # a confirmed drift appends one transition-edged `drift` ledger
+            # event and captures an incident bundle under `incident_dir`
+            if cfg.get_bool("drift_detect", False):
+                from swiftsnails_tpu.telemetry.drift import DriftSentinel
+
+                self.drift = DriftSentinel(
+                    alpha=cfg.get_float("drift_ewma_alpha", 0.3),
+                    k=cfg.get_float("drift_cusum_k", 1.0),
+                    h=cfg.get_float("drift_cusum_h", 6.0),
+                    warmup=cfg.get_int("drift_warmup", 8),
+                    ledger=self.ledger,
+                    context={"model": trainer.name,
+                             "config_hash": self.config_hash},
+                )
+            else:
+                self.drift = None
+            self.incident_dir = cfg.get_str("incident_dir", "incidents")
         else:
             self.tracer = None
             self.registry = None
             self.blackbox = None
             self._want_audit = False
+            self.timeseries = None
+            self.drift = None
+            self.profile_cadence = 0
+            self.incident_dir = ""
+        self.incidents: List[str] = []
+        self._incident_reasons: set = set()
+        self._profile_event_idx = 0
+        self._profile_pending_loss = None
         self._audit_report = None
         # table_tier: host -> the tiered parameter store (tiered/): full-size
         # masters in host RAM, fixed-budget HBM cache planes in the state
@@ -560,6 +599,10 @@ class TrainLoop:
                         # record touched rows BEFORE tier.prepare remaps the
                         # batch ids to slot space (resident/transparent path)
                         fresh.on_batch(batch, root_rng, step)
+                    if chaos is not None:
+                        # slow_step stalls the HOST before dispatch (outside
+                        # the step), mimicking a real host-blocked regression
+                        chaos.maybe_slow_step(step)
                     with step_annotation(trainer.name, step):
                         if tier is not None:
                             # fault the rows this step touches into the cache
@@ -614,6 +657,12 @@ class TrainLoop:
                         # record touched rows BEFORE tier.prepare remaps the
                         # batch ids to slot space (resident/transparent path)
                         fresh.on_batch(batch, root_rng, step)
+                    if chaos is not None and chaos.scheduled("slow_step", step):
+                        # the injected host stall runs OUTSIDE the step span,
+                        # inside its own bucketed span, so the decomposition
+                        # attributes it to host_blocked_s like a real stall
+                        with tel.span("chaos-slow", step=step):
+                            chaos.maybe_slow_step(step)
                     # step_span bridges to jax.profiler.StepTraceAnnotation,
                     # so a concurrent profile_dir capture lines device work
                     # up with these host spans by step number
@@ -648,6 +697,9 @@ class TrainLoop:
                     reg.histogram("step_ms").observe(step_ms)
                     if bb is not None:
                         bb.record_step(step, step_ms=step_ms, items=n_items)
+                    if (self.timeseries is not None
+                            and step % self.profile_cadence == 0):
+                        self._profile_sample(step, step_ms, last_metrics)
                     self.metrics.count(n_items)
                     if self.log_every and step % self.log_every == 0:
                         with tel.span("metrics-flush"):
@@ -658,6 +710,7 @@ class TrainLoop:
                                 bb.record_metrics(step, host)
                                 if bb.nonfinite(host):
                                     bb.dump("nan-loss", tracer=tel)
+                                    self._incident("nan-loss")
                     if self.backup_period and self.checkpoint_fn and step % self.backup_period == 0:
                         with tel.span("checkpoint", step=step):
                             self.checkpoint_fn(state, step)
@@ -728,6 +781,7 @@ class TrainLoop:
             bb.record_metrics(step, host)
             if bb.nonfinite(host):
                 bb.dump("nan-loss", tracer=tel)
+                self._incident("nan-loss")
         if reg is not None:
             reg.flush(step=step, final=1)
         if tel is not None:
@@ -887,6 +941,104 @@ class TrainLoop:
                 "source": "checkpoint", "error": err,
             })
 
+    # -- continuous profiling + drift (telemetry-only paths) ----------------
+
+    def _profile_sample(self, step: int, step_ms: float, last_metrics) -> None:
+        """One continuous-profiling sample (every ``profile_cadence`` steps):
+        the registry snapshot plus the goodput decomposition of the spans
+        recorded since the previous sample, the prefetch stall, and the
+        comm-audit bytes — appended to the bounded ring and fed to the
+        drift sentinel. Best-effort: profiling never fails the run."""
+        try:
+            from swiftsnails_tpu.telemetry.goodput import step_time_decomposition
+
+            row: Dict[str, float] = {}
+            if self.registry is not None:
+                for k, v in self.registry.snapshot().items():
+                    if isinstance(v, (int, float)):
+                        row[k] = float(v)
+            row["step_ms"] = float(step_ms)
+            # per-window decomposition: only the spans since the last sample,
+            # so the ring shows the run's shape over time, not a cumulative
+            # average that hides late-run drift
+            window = self.tracer.events(self._profile_event_idx)
+            self._profile_event_idx += len(window)
+            dec = step_time_decomposition(window)
+            steps_w = dec.get("steps") or 0
+            for key in ("compute_frac", "h2d_frac", "host_blocked_frac",
+                        "other_frac", "unaccounted_frac"):
+                if key in dec:
+                    row[f"win_{key}"] = dec[key]
+            if steps_w:
+                row["host_blocked_ms"] = dec["host_blocked_s"] / steps_w * 1e3
+                stall_us = sum(
+                    float(e.get("dur_us", 0.0)) for e in window
+                    if e.get("name") == "prefetch-wait")
+                row["prefetch_stall_ms"] = stall_us / 1e3 / steps_w
+            # the loss is read one sampling interval late: converting the
+            # step's own (possibly still in-flight) array would drain the
+            # async-dispatch pipeline every sample — measured ~10% of
+            # words/sec on small steps vs ~0 for reading the previous
+            # sample's long-since-materialized value
+            pending = self._profile_pending_loss
+            if last_metrics and "loss" in last_metrics:
+                self._profile_pending_loss = last_metrics["loss"]
+            if pending is not None:
+                row["loss"] = float(pending)
+            audit = self._audit_report
+            if audit and "error" not in audit:
+                if isinstance(audit.get("total_bytes"), (int, float)):
+                    row["exchange_bytes"] = float(audit["total_bytes"])
+                for scope, nbytes in (audit.get("by_scope") or {}).items():
+                    row[f"comm_bytes.{scope}"] = float(nbytes)
+            if "tier_cache_hit_rate" in row:
+                # the drift sentinel's canonical signal name
+                row["tier_hit_rate"] = row["tier_cache_hit_rate"]
+            self.timeseries.sample(step, row)
+            if self.drift is not None:
+                edges = self.drift.events
+                confirmed = self.drift.observe(step, row)
+                if confirmed and self.drift.events > edges:
+                    print(
+                        f"drift: confirmed at step {step} on "
+                        f"{', '.join(confirmed)}; capturing incident bundle",
+                        file=sys.stderr,
+                    )
+                    self._incident("drift-" + "-".join(confirmed))
+        except Exception as e:
+            print(f"telemetry: profile sample failed: {e}", file=sys.stderr)
+
+    def _incident(self, reason: str) -> Optional[str]:
+        """Capture an atomic incident bundle (blackbox ring + timeseries
+        window + config/env fingerprint + kept spans) under ``incident_dir``,
+        once per reason per run. Armed only when continuous profiling or the
+        drift sentinel is on — a bare-telemetry run leaves no dirs behind."""
+        if self.timeseries is None and self.drift is None:
+            return None
+        if not self.incident_dir or reason in self._incident_reasons:
+            return None
+        self._incident_reasons.add(reason)
+        try:
+            from swiftsnails_tpu.telemetry.drift import build_incident_bundle
+
+            context = {"model": self.trainer.name,
+                       "config_hash": self.config_hash}
+            if self.drift is not None:
+                context["drift"] = self.drift.summary()
+            path = build_incident_bundle(
+                self.incident_dir, reason,
+                blackbox=self.blackbox,
+                timeseries=self.timeseries,
+                tracer=self.tracer,
+                context=context,
+            )
+            self.incidents.append(path)
+            print(f"incident bundle: {path}", file=sys.stderr)
+            return path
+        except Exception as e:
+            print(f"telemetry: incident bundle failed: {e}", file=sys.stderr)
+            return None
+
     # -- goodput + ledger finalization (telemetry-only paths) --------------
 
     def _audit_step_fn(self, state, dev_batch, root_rng, step):
@@ -925,6 +1077,10 @@ class TrainLoop:
                 n_chips=n_chips,
             )
             self.metrics.log({"goodput": report, "step": steps})
+            if self.timeseries is not None:
+                export = self.trainer.config.get_str("profile_export", "")
+                if export:
+                    self.timeseries.export_jsonl(export)
             if self.ledger is not None:
                 record = {
                     "model": self.trainer.name,
@@ -934,6 +1090,16 @@ class TrainLoop:
                     "goodput": report,
                     "final_metrics": final_metrics or None,
                 }
+                if audit is not None and audit.get("by_scope"):
+                    # per-scope comm bytes, so `ledger-report --diff` can
+                    # attribute an exchange-byte delta to a named collective
+                    record["comm_by_scope"] = dict(audit["by_scope"])
+                if self.timeseries is not None:
+                    record["timeseries"] = self.timeseries.summary()
+                if self.drift is not None:
+                    record["drift"] = self.drift.summary()
+                if self.incidents:
+                    record["incidents"] = list(self.incidents)
                 wire = getattr(self.trainer, "comm_dtype", None)
                 if wire:
                     # the active wire format, so `ledger-report` run lines

@@ -45,6 +45,9 @@ never recompiled or slowed by the harness):
 ``partition``   a cluster worker computes on but can't reach the supervisor
                 — heartbeats drop, its lease expires, and its stale buffered
                 commits must be refused by first-writer-wins
+``slow_step``   the host sleeps ``chaos_slow_step_ms`` before dispatching the
+                step — a sustained host-blocked regression (GC storm, noisy
+                neighbor, storage stall) the drift sentinel must confirm
 ==============  ============================================================
 
 Every injection appends a ``chaos`` ledger event (when a ledger is wired),
@@ -56,6 +59,7 @@ from __future__ import annotations
 
 import os
 import re
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -69,6 +73,10 @@ FAULT_KINDS = (
     # cluster-membership kinds (PR 9): consulted by the cluster simulator,
     # scheduled by cluster-wide applied-batch tick (see cluster/sim.py)
     "worker_dead", "worker_slow", "partition",
+    # drift-sentinel kind (PR 17): host-side per-step sleep consulted by the
+    # TrainLoop *outside* the traced step span, so the stall lands in the
+    # host-blocked decomposition bucket exactly like a real host stall
+    "slow_step",
 )
 
 _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<first>\d+)(?:-(?P<last>\d+))?$")
@@ -203,13 +211,15 @@ class _ChaosStream:
 class ChaosPlan:
     """Seeded, scripted fault schedule consulted by the TrainLoop."""
 
-    def __init__(self, faults: List[Tuple[str, int]], seed: int = 0, ledger=None):
+    def __init__(self, faults: List[Tuple[str, int]], seed: int = 0, ledger=None,
+                 slow_step_ms: float = 50.0):
         self._pending: Dict[Tuple[str, int], bool] = {
             (kind, step): True for kind, step in faults
         }
         self.seed = int(seed)
         self.rng = np.random.default_rng(self.seed)
         self.ledger = ledger
+        self.slow_step_ms = float(slow_step_ms)
         self.events: List[Dict] = []
 
     @classmethod
@@ -218,7 +228,8 @@ class ChaosPlan:
         if not spec.strip():
             return None
         return cls(parse_chaos_spec(spec), seed=cfg.get_int("chaos_seed", 0),
-                   ledger=ledger)
+                   ledger=ledger,
+                   slow_step_ms=cfg.get_float("chaos_slow_step_ms", 50.0))
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -241,6 +252,12 @@ class ChaosPlan:
 
     def pending(self) -> List[Tuple[str, int]]:
         return sorted(k for k, live in self._pending.items() if live)
+
+    def scheduled(self, kind: str, step: int) -> bool:
+        """True when ``kind`` is still pending at ``step`` (peek — does not
+        consume). Lets the TrainLoop skip span bookkeeping on unaffected
+        steps."""
+        return bool(self._pending.get((kind, step)))
 
     # -- injection hooks (called by TrainLoop._resilient_step) --------------
 
@@ -282,6 +299,23 @@ class ChaosPlan:
                 metrics["loss"] = np.float32(value)
                 self._log(kind, step, {"leaf": leaf, "row": row})
         return state, metrics
+
+    def maybe_slow_step(self, step: int) -> float:
+        """``slow_step``: sleep ``chaos_slow_step_ms`` on the host before the
+        step dispatch; returns the slept milliseconds (0.0 when unscheduled).
+
+        The TrainLoop consults this BEFORE entering the traced step span
+        (wrapped in a ``chaos-slow`` span on the instrumented path), so the
+        injected stall is attributed to the host-blocked decomposition
+        bucket — the signature the drift sentinel and ``--diff`` drill on.
+        """
+        if not self._take("slow_step", step):
+            return 0.0
+        ms = self.slow_step_ms
+        self._log("slow_step", step, {"sleep_ms": ms})
+        if ms > 0:
+            time.sleep(ms / 1e3)
+        return ms
 
     def wants_preempt(self, step: int) -> Optional[str]:
         if self._take("preempt", step):

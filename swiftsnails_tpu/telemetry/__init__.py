@@ -33,6 +33,17 @@ The serving/freshness plane adds three more (docs/OBSERVABILITY.md):
 * :mod:`~swiftsnails_tpu.telemetry.ops` — the one-screen fleet dashboard
   (``python -m swiftsnails_tpu ops`` / the serve REPL's ``ops`` op).
 
+And the training plane three more (docs/OBSERVABILITY.md §11–13):
+
+* :mod:`~swiftsnails_tpu.telemetry.timeseries` — continuous profiling: a
+  bounded ring of periodic registry/goodput samples, JSONL export, and
+  terminal sparklines for ``ledger-report`` / ``ops``;
+* :mod:`~swiftsnails_tpu.telemetry.drift` — the online drift sentinel:
+  EWMA/CUSUM detectors over the training-plane signals, transition-edged
+  ``drift`` ledger events, and atomic incident bundles;
+* ``ledger-report --diff A B`` (:func:`goodput.throughput_attribution`) —
+  regression attribution between two run/bench records.
+
 Off by default: the TrainLoop only constructs these when the ``telemetry``
 or ``trace_path`` config keys are set, and its hot path pays one
 enabled-flag check otherwise.
@@ -53,10 +64,17 @@ from swiftsnails_tpu.telemetry.registry import (
     StdoutSummarySink,
 )
 from swiftsnails_tpu.telemetry.blackbox import BlackBox
+from swiftsnails_tpu.telemetry.drift import (
+    DriftSentinel,
+    EwmaCusum,
+    build_incident_bundle,
+    bundle_complete,
+)
 from swiftsnails_tpu.telemetry.goodput import (
     goodput_report,
     peaks_for,
     step_time_decomposition,
+    throughput_attribution,
 )
 from swiftsnails_tpu.telemetry.ledger import (
     Ledger,
@@ -74,6 +92,11 @@ from swiftsnails_tpu.telemetry.request_trace import (
 )
 from swiftsnails_tpu.telemetry.slo import SloObjective, SloTracker
 from swiftsnails_tpu.telemetry.summary import summarize_file
+from swiftsnails_tpu.telemetry.timeseries import (
+    TimeSeriesStore,
+    render_sparklines,
+    sparkline,
+)
 from swiftsnails_tpu.telemetry.tracer import Tracer
 
 # the JSONL sink IS the existing MetricsLogger (same ``log``/``close``
@@ -97,6 +120,14 @@ __all__ = [
     "StdoutSummarySink",
     "BlackBox",
     "Ledger",
+    "TimeSeriesStore",
+    "DriftSentinel",
+    "EwmaCusum",
+    "build_incident_bundle",
+    "bundle_complete",
+    "render_sparklines",
+    "sparkline",
+    "throughput_attribution",
     "audit_compiled",
     "audit_step",
     "collective_bytes",

@@ -88,6 +88,7 @@ _SPAN_BUCKETS = {
     "prefetch-wait": "host_blocked_s",
     "tier-fault": "host_blocked_s",       # tiered residency work on the step
     "tier-flush-wait": "host_blocked_s",  # async write-back drain barriers
+    "chaos-slow": "host_blocked_s",       # injected slow_step host sleep
     "metrics-flush": "other_s",
     "checkpoint": "other_s",
 }
@@ -259,3 +260,131 @@ def goodput_report(
     elif steps and items and step_seconds:
         report["items_per_sec"] = (items / steps) / step_seconds
     return report
+
+
+# -------------------------------------------------- regression attribution ---
+
+_ATTR_COMPONENTS = ("compute", "h2d", "host_blocked", "other", "unaccounted")
+
+
+def _per_step_components(rec: Dict) -> Dict[str, Optional[float]]:
+    """Per-step seconds for each decomposition component of one run/bench
+    record (``None`` when the record carries no decomposition)."""
+    gp = rec.get("goodput") or rec
+    dec = gp.get("decomposition") or {}
+    steps = dec.get("steps") or gp.get("steps") or 0
+    out: Dict[str, Optional[float]] = {}
+    if not steps:
+        return {c: None for c in _ATTR_COMPONENTS}
+    wall = dec.get("wall_s") or 0.0
+    accounted = 0.0
+    for comp in ("compute", "h2d", "host_blocked", "other"):
+        sec = dec.get(f"{comp}_s")
+        out[comp] = (sec / steps) if sec is not None else None
+        accounted += sec or 0.0
+    out["unaccounted"] = max(wall - accounted, 0.0) / steps if wall else None
+    return out
+
+
+def _record_rate(rec: Dict) -> Optional[float]:
+    """items/sec (words/sec) of a run/bench record, from whichever field
+    the record carries.
+
+    A record with a span decomposition is rated as items over traced
+    *wall-clock*: ``goodput.items_per_sec`` divides by the mean ``step``
+    span instead, which excludes exactly the host-blocked time a ``--diff``
+    exists to attribute (a run slowed by sleeps would look *faster*)."""
+    gp = rec.get("goodput") or {}
+    for probe in (
+        rec.get("words_per_sec"),
+        rec.get("items_per_sec"),
+        rec.get("best"),
+    ):
+        if isinstance(probe, (int, float)) and probe > 0:
+            return float(probe)
+    items = gp.get("items") or rec.get("items")
+    dec = gp.get("decomposition") or rec.get("decomposition") or {}
+    wall = dec.get("wall_s")
+    if items and isinstance(wall, (int, float)) and wall > 0:
+        return float(items) / wall
+    probe = gp.get("items_per_sec")
+    if isinstance(probe, (int, float)) and probe > 0:
+        return float(probe)
+    steps = gp.get("steps") or rec.get("steps")
+    step_s = gp.get("step_seconds")
+    if steps and items and step_s:
+        return (items / steps) / step_s
+    return None
+
+
+def throughput_attribution(rec_a: Dict, rec_b: Dict) -> Dict:
+    """Decompose the words/sec delta between two run/bench records.
+
+    The core of ``ledger-report --diff A B`` / ``tools/perf_diff.py``:
+    per-step seconds for each goodput component (compute / h2d /
+    host-blocked / other / unaccounted) are differenced A→B, per-scope
+    comm-audit bytes likewise, and the **dominant contributor** is the
+    component with the largest absolute per-step delta — the one a
+    regression (or a win) should be attributed to. Pure host arithmetic
+    over the records; tolerant of partial records (an un-decomposed side
+    yields ``None`` deltas and an ``insufficient-data`` dominant).
+    """
+    comp_a = _per_step_components(rec_a)
+    comp_b = _per_step_components(rec_b)
+    rate_a = _record_rate(rec_a)
+    rate_b = _record_rate(rec_b)
+
+    components: Dict[str, Dict] = {}
+    best_name, best_delta = None, 0.0
+    for name in _ATTR_COMPONENTS:
+        a, b = comp_a.get(name), comp_b.get(name)
+        delta = (b - a) if (a is not None and b is not None) else None
+        components[name] = {"a_s": a, "b_s": b, "delta_s": delta}
+        if delta is not None and abs(delta) > abs(best_delta):
+            best_name, best_delta = name, delta
+
+    total_delta = sum(
+        c["delta_s"] for c in components.values() if c["delta_s"] is not None
+    )
+    dominant_share = (
+        abs(best_delta) / abs(total_delta)
+        if best_name is not None and total_delta else None
+    )
+
+    # per-scope comm bytes (the audit's by_scope map, carried on run
+    # records as comm_by_scope and on bench payloads inside the audit)
+    def _by_scope(rec: Dict) -> Dict[str, float]:
+        scopes = rec.get("comm_by_scope")
+        if not scopes:
+            scopes = (rec.get("audit") or {}).get("by_scope")
+        out = {}
+        for scope, v in (scopes or {}).items():
+            bytes_ = v.get("bytes") if isinstance(v, dict) else v
+            if isinstance(bytes_, (int, float)):
+                out[scope] = float(bytes_)
+        return out
+
+    scopes_a, scopes_b = _by_scope(rec_a), _by_scope(rec_b)
+    comm: Dict[str, Dict] = {}
+    for scope in sorted(set(scopes_a) | set(scopes_b)):
+        a = scopes_a.get(scope)
+        b = scopes_b.get(scope)
+        comm[scope] = {
+            "a_bytes": a,
+            "b_bytes": b,
+            "delta_bytes": (b or 0.0) - (a or 0.0),
+        }
+
+    delta_pct = None
+    if rate_a and rate_b:
+        delta_pct = (rate_b - rate_a) / rate_a * 100.0
+    return {
+        "items_per_sec_a": rate_a,
+        "items_per_sec_b": rate_b,
+        "delta_pct": delta_pct,
+        "components": components,
+        "comm_bytes": comm,
+        "dominant": best_name or "insufficient-data",
+        "dominant_delta_s": best_delta if best_name else None,
+        "dominant_share": dominant_share,
+    }

@@ -398,6 +398,34 @@ def render_report(ledger: Ledger) -> str:
                         if isinstance(dec.get(k), (int, float))
                     )
                 )
+            # continuous-profiling sparklines, when the run carried a
+            # timeseries summary (profile_cadence > 0)
+            ts_block = r.get("timeseries")
+            if isinstance(ts_block, dict) and ts_block.get("series"):
+                from swiftsnails_tpu.telemetry.timeseries import (
+                    render_sparklines,
+                )
+
+                names = [n for n in ("step_ms", "loss",
+                                     "win_host_blocked_frac",
+                                     "win_compute_frac", "prefetch_stall_ms",
+                                     "tier_hit_rate")
+                         if n in ts_block["series"]]
+                lines.append(
+                    f"    profile: {ts_block.get('window')} samples, steps "
+                    f"{ts_block.get('first_step')}.."
+                    f"{ts_block.get('last_step')}"
+                )
+                lines.extend(render_sparklines(ts_block, names=names,
+                                               indent="      "))
+            drift = r.get("drift")
+            if isinstance(drift, dict) and (drift.get("drifted")
+                                            or drift.get("events")):
+                tripped = drift.get("tripped") or []
+                lines.append(
+                    f"    drift: {drift.get('events', 0)} event(s) on "
+                    + (", ".join(tripped) if tripped else "-")
+                )
 
     # tiered parameter store: run records carry a `tiered` summary when
     # table_tier: host was on; bench records carry the `tiered` lane block
@@ -590,7 +618,7 @@ def render_report(ledger: Ledger) -> str:
 FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
                  "retry_exhausted", "breaker", "degraded", "membership",
                  "hedge", "drain", "freshness_gap", "slo_burn",
-                 "trace_anomaly")
+                 "trace_anomaly", "drift", "scale_hint")
 
 
 def _failure_line(r: Dict) -> str:
@@ -712,6 +740,22 @@ def _failure_line(r: Dict) -> str:
             f"kinds={','.join(kinds) if isinstance(kinds, list) else kinds} "
             f"dur={_fmt_num(r.get('dur_ms', 0))}ms "
             f"total={r.get('anomalies_total')}"
+        )
+    if kind == "drift":
+        # the drift sentinel's transition-edged confirmations (telemetry/
+        # drift.py): one line per incident, naming every tripped signal
+        sigs = r.get("signals")
+        return (
+            f"  {ts}  DRIFT    step={r.get('step')} "
+            f"signals={','.join(sigs) if isinstance(sigs, list) else sigs} "
+            f"model={r.get('model', '?')}"
+        )
+    if kind == "scale_hint":
+        # the SLO tracker's should_scale() advisory edge (telemetry/slo.py)
+        kerns = r.get("kernels")
+        return (
+            f"  {ts}  SCALE-HINT source={r.get('source')} "
+            f"kernels={','.join(kerns) if isinstance(kerns, list) else kerns}"
         )
     if kind == "membership":
         # the cluster supervisor's lifecycle timeline (cluster/supervisor.py)
@@ -866,9 +910,15 @@ def check_regression(
         o_rc, o_msg = _check_trace_overhead_regression(ledger)
         if o_msg:
             msg = f"{msg}\n{o_msg}"
+        d_rc, d_msg = _check_drift_regression(ledger)
+        if d_msg:
+            msg = f"{msg}\n{d_msg}"
+        w_rc, w_msg = _check_profiler_overhead_regression(ledger)
+        if w_msg:
+            msg = f"{msg}\n{w_msg}"
         return max(
             2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-            o_rc), msg
+            o_rc, d_rc, w_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -908,9 +958,15 @@ def check_regression(
             o_rc, o_msg = _check_trace_overhead_regression(ledger)
             if o_msg:
                 msg = f"{msg}\n{o_msg}"
+            d_rc, d_msg = _check_drift_regression(ledger)
+            if d_msg:
+                msg = f"{msg}\n{d_msg}"
+            w_rc, w_msg = _check_profiler_overhead_regression(ledger)
+            if w_msg:
+                msg = f"{msg}\n{w_msg}"
             return max(
                 0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-                o_rc), msg
+                o_rc, d_rc, w_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -957,9 +1013,15 @@ def check_regression(
     o_rc, o_msg = _check_trace_overhead_regression(ledger)
     if o_msg:
         msg = f"{msg}\n{o_msg}"
+    d_rc, d_msg = _check_drift_regression(ledger)
+    if d_msg:
+        msg = f"{msg}\n{d_msg}"
+    w_rc, w_msg = _check_profiler_overhead_regression(ledger)
+    if w_msg:
+        msg = f"{msg}\n{w_msg}"
     return max(
         rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-        o_rc), msg
+        o_rc, d_rc, w_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -1498,6 +1560,94 @@ def _check_trace_overhead_regression(
     )
 
 
+def _drift_block(record: Dict) -> Optional[Dict]:
+    d = record.get("payload", {}).get("drift")
+    return d if isinstance(d, dict) else None
+
+
+def _check_drift_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the drift drill: the newest bench record carrying a ``drift``
+    block (the ``--lane drift`` / ``tools/chaos_drill.py --drift`` leg) must
+    show the injected ``slow_step`` chaos *detected* within the configured
+    window, exactly one transition-edged ``drift`` ledger event, a complete
+    incident bundle (timeseries window + blackbox + fingerprint), and the
+    before/after ``--diff`` attribution naming host-blocked as dominant.
+    Correctness, not perf — gated on any platform; no history gates
+    nothing."""
+    with_drift = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict) and _drift_block(r)
+    ]
+    if not with_drift:
+        return 0, None
+    d = _drift_block(with_drift[-1])
+    problems = []
+    if not d.get("detected"):
+        problems.append(
+            "injected slow_step drift was NOT detected within the window")
+    ev = d.get("drift_events")
+    if ev != 1:
+        problems.append(
+            f"expected exactly one transition-edged drift event, got {ev}")
+    if not d.get("bundle_complete"):
+        problems.append(
+            "incident bundle incomplete (needs timeseries + blackbox + "
+            "fingerprint)")
+    dom = (d.get("attribution") or {}).get("dominant")
+    if dom != "host_blocked":
+        problems.append(
+            f"--diff attribution named {dom!r} dominant, expected "
+            "host_blocked")
+    if problems:
+        return 1, "drift REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"drift ok: detected at step {d.get('detect_step')} "
+        f"(injected at {d.get('inject_step')}), 1 transition-edged event, "
+        "bundle complete, --diff dominant=host_blocked"
+    )
+
+
+def _profile_overhead_block(record: Dict) -> Optional[Dict]:
+    po = record.get("payload", {}).get("profile_overhead")
+    return po if isinstance(po, dict) else None
+
+
+def _check_profiler_overhead_regression(
+    ledger: Ledger,
+) -> Tuple[int, Optional[str]]:
+    """Gate the continuous profiler's own cost, mirroring the fleet lane's
+    trace-overhead leg: in the newest bench record carrying a
+    ``profile_overhead`` block, profiling on (sampler + sentinel at the
+    drill cadence) vs off at equal work must cost no more than the block's
+    ceiling (3%) of words/sec. The comparison carries a noise floor — the
+    off leg's own best-vs-worst spread across repetitions (``noise_pct``)
+    when the block ships one; a delta inside the baseline's
+    self-disagreement is scheduler jitter, not profiler cost. Same-process
+    comparison, so same-platform is free; no history gates nothing."""
+    with_po = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict) and _profile_overhead_block(r)
+    ]
+    if not with_po:
+        return 0, None
+    po = _profile_overhead_block(with_po[-1])
+    ceil = float(po.get("overhead_ceil_pct", 3.0) or 3.0)
+    pct = po.get("overhead_pct")
+    if not isinstance(pct, (int, float)):
+        return 1, ("profiler-overhead REGRESSION: block carries no "
+                   "overhead_pct")
+    noise = float(po.get("noise_pct") or 0.0)
+    if pct > max(ceil, noise):
+        return 1, (
+            f"profiler-overhead REGRESSION: continuous profiling costs "
+            f"{pct:.2f}% of words/sec (ceiling {ceil}%, noise floor "
+            f"{noise:.2f}%)")
+    return 0, (
+        f"profiler-overhead ok: {pct:+.2f}% of words/sec at cadence "
+        f"{po.get('cadence')} (ceiling {ceil}%, noise floor {noise:.2f}%)"
+    )
+
+
 def _tiered_values(record: Dict) -> Optional[Tuple[float, bool]]:
     """(words_per_sec, parity_ok) from a bench payload's ``tiered`` block, or
     None when the tiered lane didn't run in that record. ``parity_ok``
@@ -1585,6 +1735,96 @@ def _check_tiered_regression(
     )
 
 
+# ----------------------------------------------- regression attribution ---
+
+
+def _resolve_diff_record(ledger: Ledger, spec: str) -> Tuple[Dict, str]:
+    """One side of ``--diff``: an integer indexes the ledger's run records
+    (negative from the end, so ``-2 -1`` is before/after the newest pair);
+    anything else is a path to a JSON record/bench-payload file. Raises
+    ``ValueError`` with a usable message on a bad spec."""
+    try:
+        idx = int(spec)
+    except ValueError:
+        if not os.path.exists(spec):
+            raise ValueError(
+                f"--diff: {spec!r} is neither a run-record index nor a file")
+        with open(spec, "r", encoding="utf-8") as f:
+            try:
+                rec = json.load(f)
+            except ValueError:
+                # a one-record-per-line file: take the last parseable line
+                f.seek(0)
+                rec = None
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                if rec is None:
+                    raise ValueError(f"--diff: no JSON object in {spec!r}")
+        if not isinstance(rec, dict):
+            raise ValueError(f"--diff: {spec!r} is not a JSON object")
+        return rec, spec
+    runs = ledger.records("run")
+    if not runs:
+        raise ValueError("--diff: ledger has no run records")
+    try:
+        rec = runs[idx]
+    except IndexError:
+        raise ValueError(
+            f"--diff: run index {idx} out of range ({len(runs)} run records)")
+    return rec, f"run[{idx}] {rec.get('ts', '?')} {rec.get('model', '')}"
+
+
+def render_diff(rec_a: Dict, rec_b: Dict,
+                label_a: str = "A", label_b: str = "B") -> str:
+    """``ledger-report --diff A B``: decompose the words/sec delta between
+    two run/bench records into goodput components and per-scope comm bytes,
+    and name the dominant contributor (telemetry/goodput.py does the
+    arithmetic; this renders it)."""
+    from swiftsnails_tpu.telemetry.goodput import throughput_attribution
+
+    att = throughput_attribution(rec_a, rec_b)
+    lines = [f"perf diff: A = {label_a}", f"           B = {label_b}"]
+    ra, rb = att["items_per_sec_a"], att["items_per_sec_b"]
+    dp = att["delta_pct"]
+    lines.append(
+        "items/sec: "
+        f"{_fmt_num(ra) if ra else 'n/a'} -> {_fmt_num(rb) if rb else 'n/a'}"
+        + (f"  ({dp:+.2f}%)" if isinstance(dp, (int, float)) else "")
+    )
+    lines.append("per-step seconds by component (B - A):")
+    for name in ("compute", "h2d", "host_blocked", "other", "unaccounted"):
+        c = att["components"].get(name) or {}
+        a_s, b_s, d_s = c.get("a_s"), c.get("b_s"), c.get("delta_s")
+        if a_s is None and b_s is None:
+            continue
+        fmt = lambda v: f"{v * 1e3:8.3f}ms" if isinstance(v, (int, float)) \
+            else "     n/a"
+        mark = "  <-- dominant" if name == att.get("dominant") else ""
+        lines.append(
+            f"  {name:<12} {fmt(a_s)} -> {fmt(b_s)}  "
+            f"delta={fmt(d_s)}{mark}")
+    if att["comm_bytes"]:
+        lines.append("comm bytes by scope (per audited step, B - A):")
+        for scope, row in sorted(att["comm_bytes"].items()):
+            lines.append(
+                f"  {scope:<24} {_fmt_num(row.get('a_bytes') or 0)}B -> "
+                f"{_fmt_num(row.get('b_bytes') or 0)}B  "
+                f"delta={_fmt_num(row.get('delta_bytes') or 0)}B")
+    dom = att.get("dominant")
+    share = att.get("dominant_share")
+    lines.append(
+        f"dominant contributor: {dom}"
+        + (f" ({share * 100:.0f}% of the per-step delta)"
+           if isinstance(share, (int, float)) else "")
+    )
+    return "\n".join(lines)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -1617,8 +1857,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
              "cache_error events next to run records) instead of the "
              "full report",
     )
+    p.add_argument(
+        "--diff", nargs=2, metavar=("A", "B"), default=None,
+        help="regression attribution between two records: each side is a "
+             "run-record index into the ledger (negative ok; e.g. -2 -1) "
+             "or a JSON record file; decomposes the words/sec delta into "
+             "goodput components + per-scope comm bytes and names the "
+             "dominant contributor",
+    )
     args = p.parse_args(argv)
     ledger = Ledger(args.path)
+    if args.diff:
+        try:
+            rec_a, label_a = _resolve_diff_record(ledger, args.diff[0])
+            rec_b, label_b = _resolve_diff_record(ledger, args.diff[1])
+        except ValueError as e:
+            print(f"ledger_report: {e}")
+            return 2
+        print(render_diff(rec_a, rec_b, label_a, label_b))
+        return 0
     if args.failures:
         print(render_failures(ledger))
         return 0

@@ -128,6 +128,19 @@ def render_ops(
     if isinstance(slo, dict) and slo:
         lines.append("slo:")
         lines.extend(_slo_rows(slo))
+        # the should_scale() advisory, derived from the same snapshot rows:
+        # any kernel alerting or out of error budget wants capacity
+        wanting = sorted(
+            k for k, s in slo.items()
+            if s.get("alerting")
+            or (s.get("budget_remaining_pct") is not None
+                and s["budget_remaining_pct"] <= 0.0)
+        )
+        if wanting:
+            lines.append(
+                f"SCALE-UP? yes — {', '.join(wanting)} alerting or out of "
+                "error budget (advisory; scale_hint ledgered on the edge)"
+            )
     else:
         lines.append("slo: (not configured — set slo_latency_ms)")
     fresh = (health or {}).get("freshness")
@@ -244,7 +257,90 @@ def render_ops_from_ledger(ledger) -> str:
             f"{newest.get('ts', '?')} reason={newest.get('reason')} "
             f"phase={newest.get('phase', 'publish')}"
         )
+    lines.extend(_training_rows(ledger))
     return "\n".join(lines)
+
+
+# canonical sparkline set for the training section (whatever subset the
+# run's timeseries summary actually carries is drawn)
+_TRAINING_SPARKS = (
+    "step_ms", "loss", "win_host_blocked_frac", "win_compute_frac",
+    "prefetch_stall_ms", "tier_hit_rate", "tier_flush_queue_depth",
+)
+
+
+def _training_rows(ledger) -> List[str]:
+    """The training-plane section: the newest run record's goodput
+    decomposition + continuous-profiling sparklines, the drift sentinel
+    state, and the recent ``drift`` / ``scale_hint`` event tail."""
+    lines: List[str] = []
+    runs = ledger.records("run")
+    if not runs:
+        lines.append("training: (no run records)")
+    else:
+        run = runs[-1]
+        gp = run.get("goodput") if isinstance(run.get("goodput"), dict) else {}
+        head = (f"training ({run.get('ts', '?')}): model={run.get('model')} "
+                f"steps={run.get('steps')}")
+        from swiftsnails_tpu.telemetry.goodput import _record_rate
+
+        rate = _record_rate(run)  # wall-based, same rate `--diff` headlines
+        if isinstance(rate, (int, float)):
+            head += f" items/s={rate:,.0f}"
+        lines.append(head)
+        dec = gp.get("decomposition")
+        if isinstance(dec, dict) and dec.get("wall_s"):
+            lines.append(
+                "  step time: "
+                f"compute {_fmt(100 * dec.get('compute_frac', 0), 1)}% | "
+                f"h2d {_fmt(100 * dec.get('h2d_frac', 0), 1)}% | "
+                f"host-blocked {_fmt(100 * dec.get('host_blocked_frac', 0), 1)}% | "
+                f"other {_fmt(100 * dec.get('other_frac', 0), 1)}% | "
+                f"unaccounted {_fmt(100 * dec.get('unaccounted_frac', 0), 1)}%"
+            )
+        ts_block = run.get("timeseries")
+        if isinstance(ts_block, dict) and ts_block.get("series"):
+            from swiftsnails_tpu.telemetry.timeseries import render_sparklines
+
+            names = [n for n in _TRAINING_SPARKS if n in ts_block["series"]]
+            lines.append(
+                f"  profile window: {ts_block.get('window')} samples, steps "
+                f"{ts_block.get('first_step')}..{ts_block.get('last_step')}"
+            )
+            lines.extend(render_sparklines(ts_block, names=names,
+                                           indent="    "))
+        drift = run.get("drift")
+        if isinstance(drift, dict):
+            tripped = drift.get("tripped") or []
+            lines.append(
+                f"  drift sentinel: "
+                f"{'DRIFTED on ' + ', '.join(tripped) if drift.get('drifted') else 'ok'}"
+                f" ({drift.get('events', 0)} event(s))"
+            )
+        incidents = run.get("incidents")
+        if isinstance(incidents, list) and incidents:
+            lines.append(f"  incident bundles: {len(incidents)}, newest "
+                         f"{incidents[-1]}")
+    drifts = ledger.records("drift")
+    if drifts:
+        lines.append(f"drift events: {len(drifts)}, newest last:")
+        for r in drifts[-3:]:
+            sigs = r.get("signals")
+            lines.append(
+                f"  {r.get('ts', '?')}  step={r.get('step')}  "
+                f"{','.join(sigs) if isinstance(sigs, list) else sigs}"
+            )
+    else:
+        lines.append("drift events: (none ledgered)")
+    hints = ledger.records("scale_hint")
+    if hints:
+        newest = hints[-1]
+        kerns = newest.get("kernels")
+        lines.append(
+            f"scale hints: {len(hints)} events, newest {newest.get('ts', '?')} "
+            f"({','.join(kerns) if isinstance(kerns, list) else kerns})"
+        )
+    return lines
 
 
 def main(argv: Optional[List[str]] = None) -> int:

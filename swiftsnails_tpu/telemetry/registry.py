@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from collections import deque
 from typing import Deque, Dict, IO, List, Optional
 
@@ -37,20 +38,31 @@ class Counter:
 
 
 class Gauge:
-    """Last-set value (queue depth, learning rate)."""
+    """Last-set value (queue depth, learning rate).
 
-    __slots__ = ("name", "_value")
+    ``updated_at`` is the wall-clock of the last :meth:`set` (``None``
+    until first set) — the timeseries sampler and the ``ops`` dashboard
+    both read it to tell a live gauge from a stale one.
+    """
+
+    __slots__ = ("name", "_value", "_updated_at")
 
     def __init__(self, name: str):
         self.name = name
         self._value = 0.0
+        self._updated_at: Optional[float] = None
 
     def set(self, value: float) -> None:
         self._value = float(value)
+        self._updated_at = time.time()
 
     @property
     def value(self) -> float:
         return self._value
+
+    @property
+    def updated_at(self) -> Optional[float]:
+        return self._updated_at
 
 
 class Histogram:

@@ -95,6 +95,8 @@ class SloTracker:
         self._alerting: Dict[str, bool] = {k: False for k in self.objectives}
         self._burn_events = 0
         self._recorded = 0
+        self._scale_hinted = False
+        self._scale_hints = 0
 
     @classmethod
     def from_config(cls, config, *, ledger=None,
@@ -231,23 +233,38 @@ class SloTracker:
 
     def should_scale(self) -> bool:
         """The autoscaler hook: True while any kernel is alerting or has
-        spent its whole long-window budget."""
+        spent its whole long-window budget.
+
+        Transition-edged like the burn alert: the False->True crossing
+        appends one ``scale_hint`` ledger event naming the kernels that
+        want capacity (the ``ops`` dashboard's ``SCALE-UP?`` advisory and
+        the future autoscaler both read it); sustained pressure is one
+        line, and the edge re-arms once the pressure clears."""
         now = self._clock()
+        wanting = []
         with self._lock:
             for k in self.objectives:
                 if self._alerting.get(k):
-                    return True
+                    wanting.append(k)
+                    continue
                 obj = self.objectives[k]
                 total, bad = self._window_counts(k, now, self.window_s)
                 # budget fully spent counts even after the burn cooled off
                 if total and obj.budget > 0 and bad >= obj.budget * total:
-                    return True
-        return False
+                    wanting.append(k)
+            entered = bool(wanting) and not self._scale_hinted
+            self._scale_hinted = bool(wanting)
+            if entered:
+                self._scale_hints += 1
+        if entered:
+            self._note_scale_hint(wanting)
+        return bool(wanting)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {"recorded": self._recorded,
-                    "burn_events": self._burn_events}
+                    "burn_events": self._burn_events,
+                    "scale_hints": self._scale_hints}
 
     # -- ledger ----------------------------------------------------------
 
@@ -274,3 +291,21 @@ class SloTracker:
             })
         except Exception:
             pass  # record-keeping never blocks the serve path
+
+    def _note_scale_hint(self, kernels) -> None:
+        led = self.ledger
+        if led is None:
+            return
+        try:
+            led.append("scale_hint", {
+                "source": self.source,
+                "kernels": sorted(kernels),
+                "burns": {k: self.burn_rates(k) for k in kernels},
+                "budget_remaining_pct": {
+                    k: round(self.error_budget_remaining(k) * 100.0, 2)
+                    for k in kernels
+                },
+                "window_s": self.window_s,
+            })
+        except Exception:
+            pass  # advisory only — never blocks the serve path

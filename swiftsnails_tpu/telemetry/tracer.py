@@ -118,10 +118,13 @@ class Tracer:
 
     # -- export ------------------------------------------------------------
 
-    def events(self) -> List[Dict]:
-        """The recorded spans as dicts (name, ts_us, dur_us, tid, depth, args)."""
+    def events(self, start: int = 0) -> List[Dict]:
+        """The recorded spans as dicts (name, ts_us, dur_us, tid, depth,
+        args), from index ``start`` on — the continuous profiler reads only
+        its window this way, instead of re-converting the whole run's spans
+        every sample."""
         with self._lock:
-            snap = list(self._events)
+            snap = self._events[start:]
         return [
             {
                 "name": name,

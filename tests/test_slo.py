@@ -85,7 +85,8 @@ def test_slo_burn_ledger_event_is_transition_edged(tmp_path):
     assert ev["kernel"] == "pull" and ev["source"] == "fleet"
     assert ev["burn_short"] >= 2.0 and ev["burn_long"] >= 2.0
     assert ev["slo_latency_ms"] == 10.0 and ev["alert_burn"] == 2.0
-    assert trk.stats() == {"recorded": 20, "burn_events": 1}
+    assert trk.stats() == {"recorded": 20, "burn_events": 1,
+                           "scale_hints": 0}
     # recover, then burn again: a second episode is a second line
     t[0] = 200.0
     for _ in range(20):

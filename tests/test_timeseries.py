@@ -1,0 +1,160 @@
+"""Continuous profiler: bounded ring semantics, downsampling, sparkline
+rendering (p5..p95 clamp so the jit-compile outlier can't flatten the
+series), JSONL export, and the bounded summary block that rides in every
+run record for ledger-side sparklines."""
+
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.telemetry.timeseries import (
+    TimeSeriesStore,
+    downsample,
+    render_sparklines,
+    sparkline,
+)
+
+
+# ------------------------------------------------------------- the ring ----
+
+
+def test_ring_is_bounded_and_ordered():
+    ts = TimeSeriesStore(window=4)
+    for i in range(10):
+        ts.sample(i, {"step_ms": float(i)}, ts=float(i))
+    snap = ts.snapshot()
+    assert [r["step"] for r in snap] == [6, 7, 8, 9]
+    assert len(ts) == 4
+    assert ts.latest()["step"] == 9
+
+
+def test_sample_drops_non_numeric_and_coerces_bool():
+    ts = TimeSeriesStore(window=8)
+    ts.sample(1, {"loss": 0.5, "trace_id": "abc123", "alerting": True})
+    row = ts.latest()
+    assert row["loss"] == 0.5
+    assert "trace_id" not in row
+    assert row["alerting"] == 1.0
+
+
+def test_series_skips_samples_missing_the_metric():
+    ts = TimeSeriesStore(window=8)
+    ts.sample(1, {"a": 1.0})
+    ts.sample(2, {"b": 2.0})
+    ts.sample(3, {"a": 3.0})
+    steps, vals = ts.series("a")
+    assert steps == [1, 3] and vals == [1.0, 3.0]
+    assert ts.names() == ["a", "b"]
+
+
+def test_snapshot_copies_are_safe_to_mutate():
+    ts = TimeSeriesStore(window=4)
+    ts.sample(1, {"a": 1.0})
+    ts.snapshot()[0]["a"] = 99.0
+    assert ts.latest()["a"] == 1.0
+
+
+# --------------------------------------------------------- downsampling ----
+
+
+def test_downsample_preserves_order_and_means():
+    vals = [float(i) for i in range(100)]
+    out = downsample(vals, 10)
+    assert len(out) == 10
+    assert out == sorted(out)  # order-preserving on a monotone series
+    assert out[0] == sum(range(10)) / 10.0
+
+
+def test_downsample_short_series_is_identity():
+    assert downsample([1.0, 2.0], 10) == [1.0, 2.0]
+
+
+def test_downsample_nan_chunks_stay_nan():
+    out = downsample([float("nan")] * 4 + [1.0] * 4, 2)
+    assert math.isnan(out[0]) and out[1] == 1.0
+
+
+# ------------------------------------------------------------ sparkline ----
+
+
+def test_sparkline_basic_shape():
+    s = sparkline([0.0, 1.0, 2.0, 3.0])
+    assert len(s) == 4
+    assert s[0] == "▁" and s[-1] == "█"
+
+
+def test_sparkline_outlier_does_not_flatten_the_series():
+    # one jit-compile spike 1000x the steady state: with a min-max scale
+    # every steady sample would collapse to the lowest bar; the p5..p95
+    # clamp must keep the real variation visible
+    vals = [2000.0] + [1.0, 2.0, 3.0, 2.0, 1.0, 3.0, 2.0, 1.0, 3.0] * 3
+    s = sparkline(vals, width=len(vals))
+    body = s[1:]
+    assert s[0] == "█"  # the outlier clamps to the top bar
+    assert len(set(body)) > 1, f"steady-state flattened: {s!r}"
+
+
+def test_sparkline_non_finite_renders_dot_and_flat_is_low():
+    s = sparkline([1.0, float("nan"), 1.0])
+    assert s[1] == "·"
+    assert sparkline([5.0, 5.0, 5.0]) == "▁▁▁"
+    assert sparkline([]) == ""
+    assert sparkline([float("nan")] * 3) == "···"
+
+
+def test_sparkline_caps_width_by_downsampling():
+    s = sparkline([float(i) for i in range(100)], width=32)
+    assert len(s) == 32
+    assert s[0] == "▁" and s[-1] == "█"
+
+
+# ------------------------------------------------------ export + summary ----
+
+
+def test_export_jsonl_roundtrip(tmp_path):
+    ts = TimeSeriesStore(window=8)
+    for i in range(5):
+        ts.sample(i, {"loss": float(i)}, ts=float(i))
+    path = tmp_path / "window.jsonl"
+    assert ts.export_jsonl(str(path)) == 5
+    rows = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["step"] for r in rows] == [0, 1, 2, 3, 4]
+    assert rows[-1]["loss"] == 4.0
+
+
+def test_summary_is_bounded_and_downsampled():
+    ts = TimeSeriesStore(window=256)
+    for i in range(200):
+        ts.sample(i, {"step_ms": float(i % 7), "loss": 1.0 / (i + 1)})
+    s = ts.summary(max_points=40)
+    assert s["window"] == 200
+    assert s["first_step"] == 0 and s["last_step"] == 199
+    assert set(s["series"]) == {"step_ms", "loss"}
+    assert all(len(v) <= 40 for v in s["series"].values())
+    # and an empty store summarizes to an empty block, not a crash
+    assert TimeSeriesStore().summary() == {"window": 0, "series": {}}
+
+
+def test_summary_name_filter():
+    ts = TimeSeriesStore(window=8)
+    ts.sample(1, {"a": 1.0, "b": 2.0})
+    s = ts.summary(names=["b", "missing"])
+    assert set(s["series"]) == {"b"}
+
+
+def test_render_sparklines_from_summary_block():
+    ts = TimeSeriesStore(window=32)
+    for i in range(20):
+        ts.sample(i, {"step_ms": 1.0 + (i % 3), "loss": 5.0 - i * 0.1})
+    lines = render_sparklines(ts.summary(max_points=40))
+    assert len(lines) == 2
+    assert any("step_ms" in l for l in lines)
+    assert any("last=" in l for l in lines)
+    # a summary re-read from a ledger record (plain dict) renders the same
+    block = json.loads(json.dumps(ts.summary(max_points=40)))
+    assert render_sparklines(block) == lines
+    assert render_sparklines({}) == []
+    assert render_sparklines({"series": {}}) == []

@@ -19,6 +19,7 @@ resumed run whose final loss matches an undisturbed one.
     python tools/chaos_drill.py --cluster  # the membership drill matrix
     python tools/chaos_drill.py --fleet    # the replica-fleet drill matrix
     python tools/chaos_drill.py --freshness  # the delta-pipeline drill matrix
+    python tools/chaos_drill.py --drift    # the training-plane drift drill
 
 ``--serve`` runs the CPU-valid availability drill instead (the bench
 ``chaos-serve`` lane): a seeded fault matrix against a live Servant with
@@ -49,6 +50,16 @@ every replica on one shared version and parity 0.0 against the reference
 planes — plus a complete ``delta_fallback`` anomaly trace
 (detect→reload→resubscribe timeline) proving the recovery is
 reconstructable by trace id. Exit is nonzero on any unrecovered drill.
+
+``--drift`` runs the training-plane drift drill instead (the bench
+``drift`` lane): a control run and a ``slow_step@A-B`` chaos run share one
+ledger; the run's own drift sentinel must confirm the injected slow-step
+within the window, emit exactly one transition-edged ``drift`` ledger
+event, leave a complete incident bundle (blackbox + timeseries window +
+config/env fingerprint + kept traces), and the before/after ``--diff``
+attribution must name host-blocked as the dominant contributor — plus the
+continuous profiler's own overhead vs words/sec must clear the 3% gate
+(or the off leg's measured noise floor). Exit is nonzero on any miss.
 
 ``--cluster`` runs the CPU-valid membership drill matrix instead (the bench
 ``chaos-cluster`` lane, one fault kind per drill): a simulated virtual-clock
@@ -174,6 +185,48 @@ def _freshness_matrix(args) -> int:
     return 1 if failed else 0
 
 
+def _drift_matrix(args) -> int:
+    from swiftsnails_tpu.telemetry.drift_lane import drift_bench
+
+    res = drift_bench(workdir=args.workdir, small=True)
+    d, po = res["drift"], res["profile_overhead"]
+    checks = {
+        "detected_in_window": bool(d["detected"]),
+        "single_drift_event": d["drift_events"] == 1,
+        "bundle_complete": bool(d["bundle_complete"]),
+        "attribution_host_blocked": (
+            (d.get("attribution") or {}).get("dominant") == "host_blocked"),
+        "profiler_overhead_ok": (
+            isinstance(po.get("overhead_pct"), (int, float))
+            and po["overhead_pct"] <= max(po["overhead_ceil_pct"],
+                                          po.get("noise_pct") or 0.0)),
+    }
+    failed = [k for k, ok in checks.items() if not ok]
+    if args.json:
+        print(json.dumps({"drift": d, "profile_overhead": po,
+                          "checks": checks, "failed": failed}))
+    else:
+        attr = d.get("attribution") or {}
+        print(f"slow_step injected  steps {d['inject_step']}-"
+              f"{d['inject_last']} (+{d['slow_step_ms']:.0f} ms), "
+              f"sentinel confirmed at step {d['detect_step']}")
+        print(f"drift events        {d['drift_events']} "
+              f"(signals: {', '.join(d['signals']) or '-'})")
+        print(f"incident bundle     {d['bundle']} "
+              f"complete={d['bundle_complete']}")
+        print(f"--diff attribution  dominant={attr.get('dominant')} "
+              f"({attr.get('dominant_delta_s', 0) * 1e3:+.1f} ms/step, "
+              f"share {100 * (attr.get('dominant_share') or 0):.0f}%)")
+        print(f"profiler overhead   {po.get('overhead_pct')}% of words/sec "
+              f"(ceiling {po['overhead_ceil_pct']}%, noise "
+              f"{po.get('noise_pct')}%, cadence {po['cadence']})")
+        for name, ok in checks.items():
+            print(f"{name:<26}  {'PASS' if ok else 'FAIL'}")
+        print("drift drill "
+              + ("PASSED" if not failed else f"FAILED: {', '.join(failed)}"))
+    return 1 if failed else 0
+
+
 def _cluster_matrix(args) -> int:
     from swiftsnails_tpu.cluster.chaos_lane import run_cluster_drills
 
@@ -225,6 +278,12 @@ def main(argv=None) -> int:
                    help="run the replica-fleet drill matrix instead (kill/"
                         "slow one replica mid-storm; the fleet must hold the "
                         "availability floor via re-route + hedging)")
+    p.add_argument("--drift", action="store_true",
+                   help="run the training-plane drift drill instead "
+                        "(slow_step injection vs the online sentinel: "
+                        "detection + one drift event + complete incident "
+                        "bundle + host-blocked --diff attribution + the "
+                        "profiler-overhead gate)")
     p.add_argument("--freshness", action="store_true",
                    help="run the delta-pipeline drill matrix instead "
                         "(publisher kill / corrupt delta / forced gap vs a "
@@ -238,6 +297,8 @@ def main(argv=None) -> int:
         return _cluster_matrix(args)
     if args.fleet:
         return _fleet_matrix(args)
+    if args.drift:
+        return _drift_matrix(args)
     if args.freshness:
         return _freshness_matrix(args)
 

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Attribute a words/sec delta between two run records to its components.
+
+A thin wrapper over ``ledger-report --diff`` (the regression-attribution
+engine in ``swiftsnails_tpu/telemetry/goodput.py``): given two run/bench
+records, it decomposes the throughput delta into the goodput components
+(compute, h2d, host-blocked, other, unaccounted seconds per step) and the
+per-scope comm-audit bytes, and names the dominant contributor — "what
+changed" in one line instead of two raw JSON blobs.
+
+    # newest vs previous run record in the repo ledger
+    python tools/perf_diff.py -2 -1
+
+    # any two records: ledger indexes or record files (JSON, or JSONL —
+    # the last parseable line is used)
+    python tools/perf_diff.py before.json after.json
+    python tools/perf_diff.py --ledger drill/DRILL_LEDGER.jsonl -2 -1
+
+    # same engine via the CLI
+    python -m swiftsnails_tpu ledger-report --diff -2 -1
+
+Indexes address the ledger's ``run`` records (``-1`` newest, ``0`` first).
+No accelerator required.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from swiftsnails_tpu.telemetry import ledger as led
+
+    p = argparse.ArgumentParser(
+        prog="perf_diff",
+        description="decompose a words/sec delta between two run records",
+    )
+    p.add_argument("a", help="baseline: ledger index (e.g. -2) or record file")
+    p.add_argument("b", help="candidate: ledger index (e.g. -1) or record file")
+    p.add_argument("--ledger", default=None,
+                   help="ledger path for index specs (default: the repo "
+                        "RUN_LEDGER.jsonl)")
+    args = p.parse_args(argv)
+
+    path = args.ledger or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "RUN_LEDGER.jsonl")
+    ledger = led.Ledger(path)
+    try:
+        rec_a, label_a = led._resolve_diff_record(ledger, args.a)
+        rec_b, label_b = led._resolve_diff_record(ledger, args.b)
+    except ValueError as e:
+        print(f"perf_diff: {e}", file=sys.stderr)
+        return 2
+    print(led.render_diff(rec_a, rec_b, label_a=label_a, label_b=label_b))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
