@@ -136,7 +136,8 @@ _state = {
     "freshness": None,  # trainer->fleet delta pipeline lane (dict; --lane freshness)
     "drift": None,  # training-plane drift drill (dict; --lane drift)
     "profile_overhead": None,  # continuous profiler on-vs-off cost (--lane drift)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness | drift)
+    "zero": None,  # sharded-optimizer-state lane (dict; see --lane zero)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness | drift | zero)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -251,6 +252,7 @@ def _result_json(extra_error=None):
             "freshness": _state["freshness"],
             "drift": _state["drift"],
             "profile_overhead": _state["profile_overhead"],
+            "zero": _state["zero"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1739,6 +1741,322 @@ def run_drift_lane() -> int:
     return 0 if ok else 1
 
 
+# -- sharded optimizer state (zero) lane --------------------------------------
+#
+# `--lane zero` measures `optimizer_sharding: zero` (ZeRO-style weight-update
+# sharding over the data axis): per-replica HBM of the replicated optimizer/
+# parameter planes before vs after sharding (ZeroManager's adoption census),
+# audited exchange bytes of the dense-grad reduce (reduce-scatter + slice
+# all-gather vs the psum baseline — compiled-HLO shapes, so valid on CPU),
+# f32 loss parity and checkpoint byte-identity vs the unsharded run, and an
+# `overlap: 2` goodput ride-along (compute/collective step split). The block
+# lands in the result JSON (`zero`), the run ledger, and the
+# `ledger-report --check-regression` gate (`_check_zero_regression`).
+ZERO_MIN_BUDGET_S = int(os.environ.get("SSN_ZERO_MIN_BUDGET_S", "180"))
+ZERO_VOCAB = 1024 if _SMALL else 4096
+ZERO_DIM = 32 if _SMALL else 64
+ZERO_HEAD_ROWS = 256
+ZERO_BATCH_PER_SHARD = 256 if _SMALL else 1024
+ZERO_STEPS_PER_CALL = 2
+
+
+def _zero_mesh_shape(n: int):
+    """data-major (data, model) split: zero shards over the data axis, so
+    give it the bigger side — the scaling lane's model-major split would cap
+    the replicated-plane reduction at 2x on 8 devices."""
+    model = 2 if n % 2 == 0 and n > 2 else 1
+    return n // model, model
+
+
+def measure_zero(n_devices=None) -> None:
+    """Populate ``_state['zero']`` with the sharded-optimizer-state lane."""
+    import itertools
+
+    import jax
+
+    from swiftsnails_tpu.data.ctr import synth_ctr
+    from swiftsnails_tpu.data.sampler import batch_stream, skipgram_windows
+    from swiftsnails_tpu.data.vocab import Vocab
+    from swiftsnails_tpu.framework.checkpoint import build_manifest
+    from swiftsnails_tpu.models.registry import get_model
+    from swiftsnails_tpu.models.word2vec import Word2VecTrainer
+    from swiftsnails_tpu.parallel.mesh import (
+        DATA_AXIS, MODEL_AXIS, batch_sharding, make_mesh,
+    )
+    from swiftsnails_tpu.parallel.placement import PlacementManager
+    from swiftsnails_tpu.parallel.zero import ZeroManager
+    from swiftsnails_tpu.telemetry.audit import audit_step
+    from swiftsnails_tpu.utils.config import Config
+
+    devices = jax.devices()
+    n = min(n_devices or len(devices), len(devices))
+    if n < 2:
+        _state["zero"] = {
+            "skipped": f"single accelerator device (n_devices={n}); the "
+                       "sharding lane needs >= 2 (CPU smoke: set "
+                       "--xla_force_host_platform_device_count=8)",
+            "n_devices": n,
+        }
+        _state["errors"].append("zero lane skipped: single device")
+        return
+    data, model = _zero_mesh_shape(n)
+    mesh = make_mesh(
+        {DATA_AXIS: data, MODEL_AXIS: model}, devices=devices[:n])
+    bs = batch_sharding(mesh)
+
+    # word2vec hybrid-head leg: skewed corpus so the hybrid head is real
+    vocab_size = ZERO_VOCAB
+    spc = ZERO_STEPS_PER_CALL
+    macro_n = ZERO_BATCH_PER_SHARD * data * spc
+    ids = synth_corpus(max(2 * macro_n, 16_000), vocab_size, seed=31,
+                       s=SKEWED_ZIPF_S)
+    counts = np.bincount(ids, minlength=vocab_size).astype(np.int64)
+    order = np.argsort(-counts, kind="stable")
+    inv = np.empty_like(order)
+    inv[order] = np.arange(vocab_size)
+    ids = inv[ids].astype(np.int32)
+    counts = counts[order]
+    vocab = Vocab([f"w{i}" for i in range(vocab_size)],
+                  np.maximum(counts, 1))
+    rng = np.random.default_rng(37)
+    g_c, g_x = skipgram_windows(ids, WINDOW, rng)
+    batches = [
+        w for w in itertools.islice(batch_stream(g_c, g_x, macro_n, rng), 4)
+        if w["centers"].shape[0] == macro_n
+    ]
+    if not batches:
+        _state["zero"] = {
+            "skipped": f"corpus too small for one {macro_n}-word macro batch",
+            "n_devices": n,
+        }
+        _state["errors"].append("zero lane skipped: corpus too small")
+        return
+    dev_batches = [
+        {k: jax.device_put(v, bs) for k, v in b.items()} for b in batches
+    ]
+
+    def w2v_lane(zero, overlap="0"):
+        conf = _scaling_lane_config(
+            vocab_size, ZERO_DIM, macro_n // spc, spc, "float32",
+            overlap=False)
+        conf["placement"] = "hybrid"
+        conf["placement_head_rows"] = str(ZERO_HEAD_ROWS)
+        if overlap != "0":
+            conf["overlap"] = overlap
+        if zero:
+            conf["optimizer_sharding"] = "zero"
+        trainer = Word2VecTrainer(
+            Config(conf), mesh=mesh, corpus_ids=np.zeros(2, np.int32),
+            vocab=vocab)
+        state = trainer.init_state()
+        pm = PlacementManager(trainer, mesh)
+        if pm.active:
+            state = pm.adopt(state)
+        zm = ZeroManager(trainer, mesh)
+        if zm.active:
+            state = zm.adopt(state)
+        step = jax.jit(trainer.train_step, donate_argnums=(0,))
+        key = jax.random.PRNGKey(7)
+        m = None
+        for i in range(3):  # compile + identical short run for loss parity
+            state, m = step(state, dev_batches[i % len(dev_batches)],
+                            jax.random.fold_in(key, i))
+        loss = float(m["loss"])
+        t0 = time.perf_counter()
+        n_timed = 2
+        for i in range(n_timed):
+            state, m = step(state, dev_batches[i % len(dev_batches)],
+                            jax.random.fold_in(key, 10 + i))
+        _ = float(m["loss"])
+        dt = (time.perf_counter() - t0) / n_timed
+        audit = audit_step(
+            step, state, dev_batches[0], jax.random.fold_in(key, 0))
+        ops = audit.get("ops") or {}
+        return {
+            "loss": loss,
+            "words_per_sec": macro_n / dt,
+            "step_seconds": dt,
+            "audit": audit,
+            "head_push_bytes": (audit.get("by_scope") or {}).get(
+                "ssn_zero_head_push" if zero else "ssn_hybrid_head_push"),
+            # the grad-reduce component alone: reduce-scatter only appears
+            # in the zero head push on this lane (f32 wire), so the op-level
+            # total is exactly the summed-gradient exchange — the param
+            # all-gather that replaces the baseline's redundant update is
+            # the remainder of the head-push scope
+            "reduce_scatter_bytes": (
+                (ops.get("reduce-scatter") or {}).get("bytes", 0)
+                + (ops.get("all-reduce-scatter") or {}).get("bytes", 0)),
+        }
+
+    base = w2v_lane(zero=False)
+    shard = w2v_lane(zero=True)
+    block = {
+        "n_devices": n,
+        "mesh": {"data": data, "model": model},
+        "head_rows": ZERO_HEAD_ROWS,
+        "words_per_sec": {
+            "baseline": round(base["words_per_sec"], 1),
+            "zero": round(shard["words_per_sec"], 1),
+        },
+        "loss_parity_f32": _finite(abs(shard["loss"] - base["loss"]), 9),
+        # audited exchange bytes of the dense-grad REDUCE: the zero path's
+        # reduce-scatter vs the psum baseline. A ring all-reduce is
+        # internally reduce-scatter + all-gather but the audit bills it
+        # once (its defining shape), so the scatter leg is compared
+        # like-for-like; the param all-gather that replaces the baseline's
+        # redundant full-plane update is recorded separately
+        "grad_reduce": {
+            "baseline_bytes": base["head_push_bytes"],
+            "zero_bytes": shard["reduce_scatter_bytes"],
+            "param_gather_bytes": (
+                (shard["head_push_bytes"] or 0)
+                - shard["reduce_scatter_bytes"]) or None,
+            "head_push_total_bytes": shard["head_push_bytes"],
+        },
+    }
+
+    # CTR AdaGrad leg: the replicated-plane HBM census (dense optax slots +
+    # the hybrid head's accumulator plane) and checkpoint byte-identity
+    labels, feats, _ = synth_ctr(64 * data * 4, 4, 64, seed=3)
+    ctr_conf = {
+        "num_fields": "4", "capacity": "1024",
+        "batch_size": str(64 * data), "learning_rate": "0.1",
+        "num_iters": "1", "seed": "0", "hidden_dims": "64,32",
+        "embed_dim": "8", "optimizer": "adagrad", "packed": "0",
+        "placement": "hybrid", "placement_head_rows": "128",
+    }
+
+    def ctr_lane(zero):
+        conf = dict(ctr_conf)
+        if zero:
+            conf["optimizer_sharding"] = "zero"
+        tr = get_model("widedeep")(
+            Config(conf), mesh=mesh, data=(labels, feats))
+        state = tr.init_state()
+        pm = PlacementManager(tr, mesh)
+        if pm.active:
+            state = pm.adopt(state)
+        zm = ZeroManager(tr, mesh)
+        if zm.active:
+            state = zm.adopt(state)
+        step = jax.jit(tr.train_step)
+        batch = next(iter(tr.batches()))
+        dev = {k: jax.device_put(np.asarray(v)) for k, v in batch.items()}
+        state, m = step(state, dev, jax.random.PRNGKey(0))
+        if zm.active:
+            state = zm.master_state(state)
+        if pm.active:
+            state = pm.master_state(state)
+        return zm, state, float(m["loss"])
+
+    zm, ctr_shard_state, ctr_zero_loss = ctr_lane(zero=True)
+    _, ctr_base_state, ctr_base_loss = ctr_lane(zero=False)
+    hbm = dict(zm.summary() or {})
+    block["hbm"] = {
+        "planes": hbm.get("planes"),
+        "replicated_bytes": hbm.get("replicated_bytes"),
+        "sharded_bytes_per_replica": hbm.get("sharded_bytes_per_replica"),
+        "reduction": hbm.get("reduction"),
+    }
+    block["ctr_loss_parity_f32"] = _finite(
+        abs(ctr_zero_loss - ctr_base_loss), 9)
+    # checkpoint byte-identity: the manifest (per-array CRC of the exact
+    # bytes orbax writes) of the merged sharded state must equal the
+    # unsharded run's after identical steps
+    m_shard = build_manifest(ctr_shard_state, 0)["arrays"]
+    m_base = build_manifest(ctr_base_state, 0)["arrays"]
+    block["checkpoint_identical"] = bool(m_shard == m_base)
+
+    # overlap: 2 ride-along under zero: the goodput compute/collective split
+    try:
+        ov = w2v_lane(zero=True, overlap="2")
+        entry = {
+            "aggregate_words_per_sec": round(ov["words_per_sec"], 1),
+            "speedup_vs_sequential": round(
+                ov["words_per_sec"] / shard["words_per_sec"], 3),
+            "loss": _finite(ov["loss"], 6),
+        }
+        try:
+            from swiftsnails_tpu.telemetry.goodput import (
+                goodput_report, peaks_for,
+            )
+
+            if _state["device_kind"] is None:
+                _state["device_kind"] = getattr(
+                    jax.devices()[0], "device_kind", _state["platform"])
+            g = goodput_report(
+                audit=ov["audit"], steps=1, items=macro_n,
+                step_seconds=ov["step_seconds"],
+                peaks=peaks_for(_state["device_kind"]), n_chips=n,
+            )
+            split = g.get("step_split_est")
+            if split:
+                entry["step_split_est"] = {
+                    k: _finite(v, 6) for k, v in split.items()
+                }
+        except Exception as e:
+            _state["errors"].append(f"zero lane goodput failed: {e}")
+        block["overlap"] = entry
+    except Exception as e:
+        _state["errors"].append(f"zero overlap ride-along failed: {e}")
+
+    _state["zero"] = block
+    gr = block["grad_reduce"]
+    print(
+        f"bench: zero lane: {n}dev (data={data}) HBM "
+        f"{block['hbm']['replicated_bytes'] or 0:,} -> "
+        f"{block['hbm']['sharded_bytes_per_replica'] or 0:,} B/replica "
+        f"({block['hbm']['reduction']}x), grad reduce "
+        f"{gr['baseline_bytes'] or 0:,} -> {gr['zero_bytes'] or 0:,} B, "
+        f"loss parity {block['loss_parity_f32']}, "
+        f"ckpt identical {block['checkpoint_identical']}",
+        file=sys.stderr,
+    )
+
+
+def run_zero_lane() -> int:
+    """``--lane zero``: the sharded-optimizer-state lane alone, one JSON
+    line out."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "zero"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_zero()
+    except Exception as e:
+        _state["errors"].append(
+            f"zero lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["zero"]
+    if block.get("skipped"):
+        _emit_once()
+        return 1
+    # the lane's headline is the sharded run's words/sec (the cost side of
+    # the HBM trade must stay visible)
+    _state["best"] = (block.get("words_per_sec") or {}).get("zero") or 0.0
+    _state["best_path"] = "zero-f32"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    gr = block.get("grad_reduce") or {}
+    hbm = block.get("hbm") or {}
+    ok = (
+        isinstance(hbm.get("reduction"), (int, float))
+        and hbm["reduction"] >= 2.0
+        and isinstance(block.get("loss_parity_f32"), (int, float))
+        and block["loss_parity_f32"] <= 1e-6
+        and block.get("checkpoint_identical") is True
+        and isinstance(gr.get("zero_bytes"), int)
+        and isinstance(gr.get("baseline_bytes"), int)
+        and gr["zero_bytes"] <= gr["baseline_bytes"]
+    )
+    return 0 if ok else 1
+
+
 AT_SCALE_PAIRS = 255  # planted co-occurrence pairs for the structure stage
 AT_SCALE_TRAIN_S = 5.0 if _SMALL else 45.0  # wall-clock training budget
 AT_SCALE_MIN_BUDGET_S = 240  # skip the stage below this remaining budget
@@ -2093,7 +2411,8 @@ def main(argv=None):
     parser.add_argument(
         "--lane",
         choices=("full", "scaling", "chaos", "serve", "fleet", "tiered",
-                 "chaos-serve", "chaos-cluster", "freshness", "drift"),
+                 "chaos-serve", "chaos-cluster", "freshness", "drift",
+                 "zero"),
         default="full",
         help="full = the headline bench (default); scaling = the scale-out "
              "lane alone (grouped-mesh 1-vs-N throughput per comm_dtype plus "
@@ -2121,7 +2440,14 @@ def main(argv=None):
              "(slow_step injection vs the online EWMA/CUSUM sentinel: "
              "detection + one drift event + complete incident bundle + "
              "host-blocked --diff attribution, plus the continuous "
-             "profiler's own overhead vs the 3% ceiling; valid on CPU)",
+             "profiler's own overhead vs the 3% ceiling; valid on CPU); "
+             "zero = the sharded-optimizer-state lane "
+             "(optimizer_sharding: zero — per-replica HBM of the replicated "
+             "slot planes before/after sharding, audited reduce-scatter vs "
+             "psum exchange bytes, f32 loss parity + checkpoint "
+             "byte-identity vs unsharded, overlap: 2 goodput ride-along; "
+             "bytes/parity are compiled shapes + bit checks, so valid on "
+             "CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -2145,6 +2471,8 @@ def main(argv=None):
         return run_freshness_lane()
     if args.lane == "drift":
         return run_drift_lane()
+    if args.lane == "zero":
+        return run_zero_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 
@@ -2247,6 +2575,16 @@ def main(argv=None):
             _state["errors"].append(f"chaos lane failed: {e}")
     else:
         _state["errors"].append("chaos lane skipped (budget)")
+
+    # 3e. Sharded-optimizer-state lane: HBM census + grad-reduce exchange
+    #     bytes + parity under optimizer_sharding: zero (budget-guarded).
+    if BENCH_DEADLINE_S - (time.monotonic() - _T0) >= ZERO_MIN_BUDGET_S:
+        try:
+            measure_zero()
+        except Exception as e:
+            _state["errors"].append(f"zero lane failed: {e}")
+    else:
+        _state["errors"].append("zero lane skipped (budget)")
 
     # 4. Host input-pipeline rate must sustain the device rate. Never let a
     #    pipeline-measurement failure discard the measured device result.

@@ -284,6 +284,7 @@ def save_checkpoint(
     tier=None,
     retry=None,
     placement=None,
+    zero=None,
 ) -> str:
     """Write a sharded checkpoint for ``step`` under ``root`` (param_backup
     parity), committed by a checksum manifest.
@@ -314,6 +315,12 @@ def save_checkpoint(
     if tier is not None:
         state = tier.master_state(state)
         wait = True
+    if zero is not None:
+        # ZeRO 1/data optimizer-plane shards -> replicated placement before
+        # the manifest is built (values are unchanged — sharding is
+        # placement, not layout — so on disk a sharded run is byte-identical
+        # to an unsharded one and restore needs no zero awareness)
+        state = zero.master_state(state)
     if placement is not None:
         # hybrid head/tail planes -> the uniform master layout (eager,
         # value-preserving concat into NEW buffers, so the async write path

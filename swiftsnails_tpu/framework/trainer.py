@@ -60,8 +60,14 @@ class Trainer:
     name: str = "trainer"
 
     def __init__(self, config: Config, mesh: Optional[Mesh] = None):
+        from swiftsnails_tpu.parallel.zero import resolve_optimizer_sharding
+
         self.config = config
         self.mesh = mesh
+        # optimizer_sharding: zero -> ZeRO-style update sharding of every
+        # replicated optimizer plane across the data axis (parallel/zero.py)
+        self.optimizer_sharding = resolve_optimizer_sharding(
+            config.get_str("optimizer_sharding", "none"))
 
     # -- subclass API ------------------------------------------------------
 
@@ -137,6 +143,19 @@ class Trainer:
         (names match :meth:`tier_tables`); ``None``/empty means uniform
         placement and the loop pays nothing."""
         return None
+
+    # -- ZeRO hooks (optimizer_sharding: zero; parallel/zero.py) -----------
+
+    def zero_planes(self, state: Any) -> Any:
+        """Replicated dense-optimizer subtree of the state pytree whose
+        eligible leaves ZeroManager shards across the data axis; ``None``
+        (default) means this trainer carries no dense optimizer planes
+        (hybrid head slots are discovered through :meth:`tier_tables`)."""
+        return None
+
+    def zero_with_planes(self, state: Any, planes: Any) -> Any:
+        """Rebuild the state pytree with the optimizer subtree replaced."""
+        return state
 
 
 class _Prefetcher:
@@ -302,7 +321,7 @@ class TrainLoop:
                     config_hash=self.config_hash,
                     keep=self.backup_keep, protect=self._restored_step,
                     ledger=self.ledger, tier=self.tier, retry=ckpt_retry,
-                    placement=self.placement,
+                    placement=self.placement, zero=self.zero,
                 )
         self.checkpoint_fn = checkpoint_fn
         self.profiler = StepProfiler(cfg)
@@ -424,6 +443,13 @@ class TrainLoop:
 
         pm = PlacementManager(trainer, trainer.mesh)
         self.placement = pm if pm.active else None
+        # optimizer_sharding: zero -> shard replicated optimizer planes
+        # across the data axis (parallel/zero.py). Inactive (none, or no
+        # mesh) => None and the loop pays nothing.
+        from swiftsnails_tpu.parallel.zero import ZeroManager
+
+        zm = ZeroManager(trainer, trainer.mesh)
+        self.zero = zm if zm.active else None
         # freshness_publish: N steps + freshness_dir -> hot-row delta
         # publishing to serving subscribers (freshness/; docs/FRESHNESS.md).
         # Off (the default) => None and the hot path pays one flag check.
@@ -533,6 +559,11 @@ class TrainLoop:
             # value-preserving; runs AFTER resume so a uniform-layout
             # checkpoint restores transparently into a hybrid run)
             state = self.placement.adopt(state)
+        if self.zero is not None:
+            # replicated optimizer planes -> 1/data resident shards
+            # (placement-only, values unchanged; runs AFTER placement.adopt
+            # so the hybrid head's slot planes exist to shard)
+            state = self.zero.adopt(state)
         fresh = self.freshness
         if fresh is not None:
             # one publisher incarnation per run, based on the resumed step;
@@ -767,6 +798,11 @@ class TrainLoop:
             # the caller the full-size master-backed state (same pytree type,
             # shapes, dtypes as a resident run — export/eval are unchanged)
             state = tier.master_state(state)
+        if self.zero is not None:
+            # 1/data shards -> replicated placement (values unchanged), so
+            # end-of-run consumers see the same resident layout as an
+            # unsharded run
+            state = self.zero.master_state(state)
         if self.placement is not None:
             # head/tail planes -> uniform layout: callers (export, eval,
             # serving snapshots) only ever see the master layout
@@ -1125,6 +1161,10 @@ class TrainLoop:
                         if audit.get("by_table"):
                             pl["measured_by_table"] = dict(audit["by_table"])
                     record["placement"] = pl
+                if self.zero is not None and self.zero.summary():
+                    # the ZeRO sharding decision: plane count, replicated vs
+                    # sharded HBM bytes/replica, reduction factor
+                    record["zero"] = self.zero.summary()
                 if self.preempted:
                     record["preempted"] = True
                 self.ledger.append(

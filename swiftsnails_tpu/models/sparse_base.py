@@ -127,6 +127,12 @@ class SparseCTRTrainer(Trainer):
         self.comm_dtype = apply_int4_block(
             resolve_comm_dtype(cfg.get_str("comm_dtype", "float32")),
             cfg.get_int("comm_int4_block", 0))
+        # optimizer_sharding: zero (parallel/zero.py) — the dense optax
+        # planes are resharded by ZeroManager.adopt and kept sharded through
+        # the step by the constraint in train_step; the hybrid head's slot
+        # planes ride the reduce-scatter push (zero=True below)
+        self.zero = (self.optimizer_sharding == "zero"
+                     and self.mesh is not None)
         # placement: uniform|hybrid|auto — head/tail hybrid placement of the
         # hashed table (parallel/hybrid.py). CTR row ids are hash outputs, so
         # `auto` (which needs frequency-rank prefix structure) resolves to
@@ -207,6 +213,16 @@ class SparseCTRTrainer(Trainer):
             align = small_group(self.table_dim) * model
         else:
             align = model
+        if getattr(self, "zero", False):
+            # ZeRO head push updates a 1/data slice per replica, so the head
+            # row (tile) count must also divide by the data axis
+            import math
+
+            from swiftsnails_tpu.parallel.mesh import DATA_AXIS
+
+            data = self.mesh.shape[DATA_AXIS]
+            g = align // model if self.packed else 1
+            align = math.lcm(align, max(g, 1) * data)
         cut = cfg.get_int("placement_head_rows", 0) or min(
             1024, self.capacity // 2)
         cut = min(int(cut), self.capacity // 2)
@@ -237,6 +253,37 @@ class SparseCTRTrainer(Trainer):
         if self.mesh is None:
             return contextlib.nullcontext()
         return jax.named_scope("ssn_tbl_table")
+
+    # -- ZeRO update sharding (optimizer_sharding: zero; parallel/zero.py) ---
+
+    def _zero_scope(self):
+        """Comm-audit scope for the sharded dense update's collectives."""
+        if not self.zero:
+            return contextlib.nullcontext()
+        return jax.named_scope("ssn_zero_dense_update")
+
+    def _zero_constrain(self, opt):
+        from jax.sharding import NamedSharding
+
+        from swiftsnails_tpu.parallel.mesh import DATA_AXIS
+        from swiftsnails_tpu.parallel.zero import zero_plane_spec
+
+        data = self.mesh.shape[DATA_AXIS]
+
+        def place(leaf):
+            spec = zero_plane_spec(leaf, data)
+            if spec is None:
+                return leaf
+            return jax.lax.with_sharding_constraint(
+                leaf, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map(place, opt)
+
+    def zero_planes(self, state: CTRState):
+        return state.opt
+
+    def zero_with_planes(self, state: CTRState, planes):
+        return CTRState(table=state.table, dense=state.dense, opt=planes)
 
     # -- subclass API ------------------------------------------------------
 
@@ -338,6 +385,7 @@ class SparseCTRTrainer(Trainer):
                         return push_hybrid_packed_small(
                             self.mesh, table_state, rows, grads, self.access,
                             lr, self.table_dim, comm_dtype=self.comm_dtype,
+                            zero=self.zero,
                         )
                     from swiftsnails_tpu.parallel.transfer import (
                         push_collective_packed_small,
@@ -357,7 +405,8 @@ class SparseCTRTrainer(Trainer):
 
             with self._tbl_scope():
                 return push_hybrid(self.mesh, table_state, rows, grads,
-                                   self.access, lr, comm_dtype=self.comm_dtype)
+                                   self.access, lr, comm_dtype=self.comm_dtype,
+                                   zero=self.zero)
         return push(table_state, rows, grads, self.access, lr)
 
     def _row_chunks(self, rows_per_chunk: int = 1 << 20):
@@ -417,8 +466,17 @@ class SparseCTRTrainer(Trainer):
         table = self._push_rows(
             state.table, rows, dp.reshape(-1, self.table_dim), self.lr)
         if state.dense:
-            updates, opt = self.dense_opt.update(dd, state.opt, state.dense)
-            dense = optax.apply_updates(state.dense, updates)
+            with self._zero_scope():
+                updates, opt = self.dense_opt.update(
+                    dd, state.opt, state.dense)
+                dense = optax.apply_updates(state.dense, updates)
+                if self.zero:
+                    # keep the optax planes sharded through the step: the
+                    # out constraint makes GSPMD partition the elementwise
+                    # AdaGrad math (grad reduce arrives reduce-scattered,
+                    # each replica updates its owned slice) instead of
+                    # all-gathering the accumulators back per step
+                    opt = self._zero_constrain(opt)
         else:
             dense, opt = state.dense, state.opt
         acc = ((logits > 0) == (labels > 0.5)).mean()

@@ -217,19 +217,34 @@ class Word2VecTrainer(Trainer):
         self.comm_dtype = apply_int4_block(
             resolve_comm_dtype(cfg.get_str("comm_dtype", "float32")),
             cfg.get_int("comm_int4_block", 0))
-        # overlap: 1 -> software-pipelined macro-step on the grouped mesh
-        # plane: substep i's push collectives issue together with substep
-        # i+1's pull (which reads the PRE-push tables — stale-by-one reads,
-        # the reference's async-SGD semantics), so XLA can emit async
-        # -start/-done collective pairs that run under compute. Takes effect
-        # only under a mesh with steps_per_call > 1; single-device grouped
-        # runs the fused kernel unchanged.
-        self.overlap = cfg.get_bool("overlap", False)
+        # optimizer_sharding: zero (parallel/zero.py): word2vec trains SGD
+        # (no slot planes), so zero here is a wire-path change — the hybrid
+        # head push reduce-scatters the summed grad, updates the owned
+        # slice, and all-gathers params back (bit-identical at f32)
+        self.zero = (self.optimizer_sharding == "zero"
+                     and self.mesh is not None)
+        # overlap: 1|2 -> software-pipelined macro-step on the grouped mesh
+        # plane. Depth 1: substep i's push collectives issue together with
+        # substep i+1's pull (which reads the PRE-push tables — stale-by-one
+        # reads, the reference's async-SGD semantics), so XLA can emit async
+        # -start/-done collective pairs that run under compute. Depth 2: a
+        # true double-buffered pipeline — TWO pulls stay in flight, so the
+        # push+update of substep i overlaps a FULL substep of compute (pulls
+        # read stale-by-two state; same async-SGD family, one step deeper).
+        # Takes effect only under a mesh with steps_per_call > depth;
+        # single-device grouped runs the fused kernel unchanged.
+        try:
+            self.overlap = cfg.get_int("overlap", 0)
+        except ValueError:  # bool spellings (overlap: true) keep working
+            self.overlap = int(cfg.get_bool("overlap", False))
+        if self.overlap not in (0, 1, 2):
+            raise ValueError(
+                f"overlap must be 0, 1 or 2, got {self.overlap}")
         if self.overlap and not (
             cfg.get_bool("fused", False) and cfg.get_bool("grouped", False)
         ):
             raise ValueError(
-                "overlap: 1 requires fused: 1, grouped: 1 (the grouped "
+                "overlap: 1|2 requires fused: 1, grouped: 1 (the grouped "
                 "collective plane is the only overlap-scheduled path)")
 
         # table_tier: host -> the tiered parameter store (tiered/): host-RAM
@@ -427,7 +442,14 @@ class Word2VecTrainer(Trainer):
         else:
             cut = self.placement_head_rows or min(1024, self.capacity // 2)
         cut = min(int(cut), self.capacity // 2)
-        cut -= cut % model
+        align = model
+        if self.zero:
+            # the ZeRO head push updates a 1/data row slice per replica, so
+            # the cut must divide by the data axis too
+            import math
+
+            align = math.lcm(model, data)
+        cut -= cut % align
         if cut <= 0:
             resolve_uniform("cut resolved to 0 (flat distribution or "
                             "head smaller than the model axis)")
@@ -558,11 +580,11 @@ class Word2VecTrainer(Trainer):
                     return push_hybrid_packed_bucketed(
                         self.mesh, table_state, rows, grads, self.access, lr,
                         slack=self.bucket_slack, comm_dtype=self.comm_dtype,
-                        seed=seed)
+                        seed=seed, zero=self.zero)
                 return push_hybrid_packed(
                     self.mesh, table_state, rows, grads, self.access, lr,
                     self._hybrid_cap(rows.shape[0]),
-                    comm_dtype=self.comm_dtype, seed=seed)
+                    comm_dtype=self.comm_dtype, seed=seed, zero=self.zero)
             if self.push_mode == "bucketed":
                 from swiftsnails_tpu.parallel.transfer import (
                     push_collective_packed_bucketed,
@@ -1083,7 +1105,8 @@ class Word2VecTrainer(Trainer):
                     out_table, d2 = push_hybrid_packed(
                         self.mesh, state.out_table, out_pull_rows, out_grads,
                         self.access, lr, cap, index=u_index,
-                        comm_dtype=self.comm_dtype, seed=seed)
+                        comm_dtype=self.comm_dtype, seed=seed,
+                        zero=self.zero)
                 else:
                     from swiftsnails_tpu.parallel.transfer import (
                         push_collective_packed_dedup,
@@ -1099,33 +1122,58 @@ class Word2VecTrainer(Trainer):
         return W2VState(in_table, out_table), loss, d_pull + d1 + d2
 
     def _overlap_macro(self, state: W2VState, c, x, keys, lr):
-        """Software-pipelined macro-step over the grouped mesh plane
-        (``overlap: 1``): each scan iteration issues substep i+1's pull
+        """Software-pipelined macro-step over the grouped mesh plane.
+
+        ``overlap: 1`` — each scan iteration issues substep i+1's pull
         against the PRE-push tables and substep i's push with no data
         dependence between the two, so XLA is free to emit async
         ``-start``/``-done`` collective pairs that run the push all_gather
         under the next pull + compute (the 2204.06514 overlap lever).
 
-        Semantics: substep i >= 1 reads rows that miss substep i-1's update
-        — stale-by-one async SGD, the reference worker's pipeline behavior
-        (pull for the next batch outstanding while the push callback is in
-        flight, transfer.h:55-268). The final iteration prefetches substep 0
-        again to keep shapes static; that pull is discarded (1/t overhead).
+        ``overlap: 2`` — a true two-deep software pipeline (the MPMD
+        pipelining shape of arXiv 2412.14374 collapsed onto one program):
+        the carry double-buffers TWO in-flight pulled bundles, so the pull
+        collective issued for substep i+2 has a FULL substep of compute
+        (substep i's grads + push) between its -start and the iteration
+        that consumes it — not just the tail of its own iteration. Composes
+        with dedup/bucketed/comm_dtype/zero unchanged: the substep math is
+        identical, only consumption is deferred one more iteration.
+
+        Semantics: substep i reads rows that miss the last ``depth``
+        substeps' updates — stale-by-``depth`` async SGD, the reference
+        worker's pipeline behavior (pulls for upcoming batches outstanding
+        while push callbacks are in flight, transfer.h:55-268). The final
+        ``depth`` iterations prefetch wrapped-around substeps to keep
+        shapes static; those pulls are discarded (``depth/t`` overhead).
         """
         t = c.shape[0]
-        pulled0 = self._pull_grouped_mesh(state, c[0], x[0], keys[0])
-        nxt = (jnp.roll(c, -1, axis=0), jnp.roll(x, -1, axis=0),
-               jnp.roll(keys, -1, axis=0))
+        depth = min(self.overlap, t)
+        warm = [self._pull_grouped_mesh(state, c[i], x[i], keys[i])
+                for i in range(depth)]
+        nxt = (jnp.roll(c, -depth, axis=0), jnp.roll(x, -depth, axis=0),
+               jnp.roll(keys, -depth, axis=0))
+
+        if depth <= 1:
+            def body(carry, xs):
+                st, pulled = carry
+                cn, xn, kn = xs
+                pulled_next = self._pull_grouped_mesh(st, cn, xn, kn)
+                st, loss, dropped = self._push_grouped_mesh(st, pulled, lr)
+                return (st, pulled_next), (loss, dropped)
+
+            (state, _), (losses, drops) = jax.lax.scan(
+                body, (state, warm[0]), nxt)
+            return state, losses, drops
 
         def body(carry, xs):
-            st, pulled = carry
+            st, p0, p1 = carry
             cn, xn, kn = xs
-            pulled_next = self._pull_grouped_mesh(st, cn, xn, kn)
-            st, loss, dropped = self._push_grouped_mesh(st, pulled, lr)
-            return (st, pulled_next), (loss, dropped)
+            p2 = self._pull_grouped_mesh(st, cn, xn, kn)
+            st, loss, dropped = self._push_grouped_mesh(st, p0, lr)
+            return (st, p1, p2), (loss, dropped)
 
-        (state, _), (losses, drops) = jax.lax.scan(
-            body, (state, pulled0), nxt)
+        (state, _, _), (losses, drops) = jax.lax.scan(
+            body, (state, warm[0], warm[1]), nxt)
         return state, losses, drops
 
     def _substep_packed_perpair(self, state: W2VState, centers, contexts,

@@ -344,6 +344,73 @@ def reduce_sum_quantized(
     return g.reshape((axis_size,) + x.shape).astype(jnp.float32).sum(axis=0)
 
 
+def reduce_scatter_quantized(
+    x: jax.Array,
+    axis_name: str,
+    comm_dtype: str,
+    axis_size: int,
+    stochastic: bool = False,
+    seed=None,
+) -> jax.Array:
+    """ZeRO twin of :func:`reduce_sum_quantized`: each shard receives only
+    its OWN ``1/axis_size`` leading-axis slice of the summed gradient (the
+    weight-update-sharding reduce of arXiv 2004.13336), instead of every
+    shard materializing the full sum.
+
+    Bit-parity contract: the returned slice is **bit-identical** to the same
+    slice of ``reduce_sum_quantized(x, ...)`` for every wire format —
+
+    * ``float32`` — ``lax.psum_scatter`` (tiled). XLA's reduce-scatter applies
+      the same shard-order f32 adds as the psum, so slicing the psum result
+      and psum-scattering agree bit-for-bit (pinned by tests).
+    * ``bfloat16``/``int8``/``int4`` — each shard quantizes its FULL local
+      buffer with the same codec + dither seed as the all-gather path, but
+      moves it with a tiled ``all_to_all`` (shard ``j`` receives every
+      shard's quantized rows of slice ``j`` only — 1/axis_size the received
+      bytes of the all_gather), then dequantizes and f32-sums in shard order.
+      Same per-shard quantization, same accumulation order => the owned
+      slice of the unsharded sum, exactly.
+
+    ``x.shape[0]`` must divide by ``axis_size`` (callers pad/align the plane
+    the way the hybrid head aligns its cut).
+    """
+    if x.shape[0] % axis_size:
+        raise ValueError(
+            f"reduce_scatter_quantized: leading dim {x.shape[0]} not "
+            f"divisible by axis size {axis_size}")
+    if comm_dtype == "float32":
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    own = x.shape[0] // axis_size
+
+    def _a2a(w):
+        return lax.all_to_all(w, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+    if comm_dtype == "bfloat16":
+        w = _a2a(_bf16_wire(x))
+        contrib = _bf16_unwire(w, jnp.float32)
+        return contrib.reshape((axis_size, own) + x.shape[1:]).sum(axis=0)
+    if is_int4(comm_dtype):
+        block = int4_block(comm_dtype)
+        packed, scale_w = quantize_int4(
+            x, stochastic=stochastic,
+            seed=_salted(seed, axis_name) if stochastic else None,
+            block=block)
+        p_all = _a2a(packed)
+        s_all = _a2a(scale_w)
+        contrib = dequantize_int4(
+            p_all, s_all, (p_all.shape[0],) + x.shape[1:], block=block)
+        return contrib.reshape((axis_size, own) + x.shape[1:]).sum(axis=0)
+    q, scale = quantize_int8(
+        x, stochastic=stochastic,
+        seed=_salted(seed, axis_name) if stochastic else None,
+    )
+    q_all = _a2a(q)
+    s_all = _a2a(scale)
+    contrib = dequantize_int8(q_all, s_all)
+    return contrib.reshape((axis_size, own) + x.shape[1:]).sum(axis=0)
+
+
 def all_gather_quantized(
     x: jax.Array,
     axis_name: str,

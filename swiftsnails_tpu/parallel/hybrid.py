@@ -48,6 +48,7 @@ from swiftsnails_tpu.utils.compat import shard_map
 
 from swiftsnails_tpu.parallel.access import AccessMethod
 from swiftsnails_tpu.parallel.comm import (
+    reduce_scatter_quantized,
     reduce_sum_quantized,
     resolve_comm_dtype,
 )
@@ -217,7 +218,7 @@ def head_pull(mesh: Mesh, head: jax.Array, rows: jax.Array,
 def head_push(mesh: Mesh, head: jax.Array, head_slots: Dict[str, jax.Array],
               rows: jax.Array, grads: jax.Array, access: AccessMethod, lr,
               layout: str, dim: int = 0, group: int = 1,
-              comm_dtype: str = "float32", seed=None):
+              comm_dtype: str = "float32", seed=None, zero: bool = False):
     comm_dtype = resolve_comm_dtype(comm_dtype)
     data = mesh.shape[DATA_AXIS]
     cut_t = head.shape[0]
@@ -235,6 +236,17 @@ def head_push(mesh: Mesh, head: jax.Array, head_slots: Dict[str, jax.Array],
     # rule its tail/uniform baseline uses or hybrid-vs-uniform drifts on
     # every duplicated hot row.
     per_sample = layout == "dense" and "accum" in slot_keys
+    # ZeRO update sharding (arXiv 2004.13336): the summed grad arrives via
+    # reduce-scatter, each data shard updates only its owned 1/data row
+    # slice of the head plane, and only the PARAM slice is all-gathered back
+    # (exact f32 concat — bit-identical to the replicated update). Slot
+    # planes stay resident as shards (out spec P(data)): that is the HBM
+    # win. The param must stay replicated because head_pull is a
+    # zero-collective local gather.
+    if zero and cut_t % data:
+        raise ValueError(
+            f"optimizer_sharding: zero needs head rows ({cut_t}) aligned to "
+            f"the data axis ({data}); widen placement alignment")
 
     def local(head, slots, rows, grads, *dither):
         if layout == "small":
@@ -251,38 +263,61 @@ def head_push(mesh: Mesh, head: jax.Array, head_slots: Dict[str, jax.Array],
             idx = jnp.where(rows >= 0, rows, cut_t)
             buf = jnp.zeros((cut_t,) + grads.shape[1:], jnp.float32).at[
                 idx].add(grads.astype(jnp.float32), mode="drop")
-        tot = reduce_sum_quantized(
-            buf, DATA_AXIS, comm_dtype, axis_size=data, stochastic=True,
-            seed=dither[0] if dither else None)
+
+        if zero:
+            own = cut_t // data
+            p = lax.dynamic_slice_in_dim(
+                head, lax.axis_index(DATA_AXIS) * own, own, axis=0)
+
+            def reduce(b, s):
+                return reduce_scatter_quantized(
+                    b, DATA_AXIS, comm_dtype, axis_size=data,
+                    stochastic=True, seed=s)
+        else:
+            p = head
+
+            def reduce(b, s):
+                return reduce_sum_quantized(
+                    b, DATA_AXIS, comm_dtype, axis_size=data,
+                    stochastic=True, seed=s)
+
+        tot = reduce(buf, dither[0] if dither else None)
         if per_sample:
             buf2 = jnp.zeros((cut_t,) + grads.shape[1:], jnp.float32).at[
                 idx].add(jnp.square(grads.astype(jnp.float32)), mode="drop")
-            tot2 = reduce_sum_quantized(
-                buf2, DATA_AXIS, comm_dtype, axis_size=data, stochastic=True,
-                seed=dither[0] + jnp.uint32(1) if dither else None)
+            tot2 = reduce(
+                buf2, dither[0] + jnp.uint32(1) if dither else None)
             accum = slots["accum"].astype(jnp.float32) + tot2
             step = lr * tot * lax.rsqrt(accum + access.eps)
-            new_p = head - step.astype(head.dtype)
+            new_p = p - step.astype(p.dtype)
             out = {"accum": accum.astype(slots["accum"].dtype)}
-            return new_p, {k: out.get(k, slots[k]) for k in slot_keys}
-        if fused_small:
-            cur = head.astype(jnp.float32)
+            new_s = {k: out.get(k, slots[k]) for k in slot_keys}
+        elif fused_small:
+            cur = p.astype(jnp.float32)
             accum = cur[:, 1, :] + tot * tot
             param = cur[:, 0, :] - lr * tot * lax.rsqrt(accum + access.eps)
-            return jnp.stack([param, accum], axis=1).astype(head.dtype), {}
-        merged = tot.reshape((cut_t, 1, ROW_LANES)) if layout == "small" else tot
-        new_p, new_s = access.apply_push_value(head, slots, merged, lr)
-        return new_p, {k: new_s[k] for k in slot_keys}
+            new_p = jnp.stack([param, accum], axis=1).astype(p.dtype)
+            new_s = {}
+        else:
+            merged = tot.reshape(
+                (p.shape[0], 1, ROW_LANES)) if layout == "small" else tot
+            new_p, ns = access.apply_push_value(p, slots, merged, lr)
+            new_s = {k: ns[k] for k in slot_keys}
+        if zero:
+            new_p = lax.all_gather(new_p, DATA_AXIS, tiled=True)
+        return new_p, new_s
 
+    slot_spec = P(DATA_AXIS) if zero else P()
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), {k: P() for k in slot_keys},
+        in_specs=(P(), {k: slot_spec for k in slot_keys},
                   P(DATA_AXIS), P(DATA_AXIS)) + extra_specs,
-        out_specs=(P(), {k: P() for k in slot_keys}),
+        out_specs=(P(), {k: slot_spec for k in slot_keys}),
         check_vma=False,
     )
-    with jax.named_scope("ssn_hybrid_head_push"):
+    scope = "ssn_zero_head_push" if zero else "ssn_hybrid_head_push"
+    with jax.named_scope(scope):
         return fn(head, dict(head_slots), rows, grads, *extra)
 
 
@@ -302,14 +337,14 @@ def pull_hybrid(mesh: Mesh, hs: HybridTableState, rows: jax.Array,
 def push_hybrid(mesh: Mesh, hs: HybridTableState, rows: jax.Array,
                 grads: jax.Array, access: AccessMethod, lr,
                 exact: bool = False, comm_dtype: str = "float32",
-                seed=None) -> HybridTableState:
+                seed=None, zero: bool = False) -> HybridTableState:
     cut = hs.head.shape[0]
     t_ids = tail_ids(rows, cut, hs.tail.capacity)
     tail = push_collective(mesh, hs.tail, t_ids, grads, access, lr,
                            exact=exact, comm_dtype=comm_dtype, seed=seed)
     head, head_slots = head_push(
         mesh, hs.head, hs.head_slots, rows, grads, access, lr,
-        layout="dense", comm_dtype=comm_dtype, seed=seed)
+        layout="dense", comm_dtype=comm_dtype, seed=seed, zero=zero)
     return HybridTableState(head=head, head_slots=head_slots, tail=tail)
 
 
@@ -338,7 +373,8 @@ def pull_hybrid_packed(mesh: Mesh, hs: HybridTableState, rows: jax.Array,
 def push_hybrid_packed(mesh: Mesh, hs: HybridTableState, rows: jax.Array,
                        grads: jax.Array, access: AccessMethod, lr,
                        tail_cap: int, index=None,
-                       comm_dtype: str = "float32", seed=None):
+                       comm_dtype: str = "float32", seed=None,
+                       zero: bool = False):
     """-> (new_state, dropped). ``index`` reuses a pull's (uniq, inv)."""
     cut = hs.head.shape[0]
     t_ids = tail_ids(rows, cut, hs.tail.capacity)
@@ -347,7 +383,7 @@ def push_hybrid_packed(mesh: Mesh, hs: HybridTableState, rows: jax.Array,
         comm_dtype=comm_dtype, seed=seed)
     head, head_slots = head_push(
         mesh, hs.head, hs.head_slots, rows, grads, access, lr,
-        layout="packed", comm_dtype=comm_dtype, seed=seed)
+        layout="packed", comm_dtype=comm_dtype, seed=seed, zero=zero)
     return HybridTableState(head=head, head_slots=head_slots, tail=tail), dropped
 
 
@@ -355,7 +391,8 @@ def push_hybrid_packed_bucketed(mesh: Mesh, hs: HybridTableState,
                                 rows: jax.Array, grads: jax.Array,
                                 access: AccessMethod, lr,
                                 slack: float = 2.0,
-                                comm_dtype: str = "float32", seed=None):
+                                comm_dtype: str = "float32", seed=None,
+                                zero: bool = False):
     cut = hs.head.shape[0]
     t_ids = tail_ids(rows, cut, hs.tail.capacity)
     tail, dropped = push_collective_packed_bucketed(
@@ -363,7 +400,7 @@ def push_hybrid_packed_bucketed(mesh: Mesh, hs: HybridTableState,
         comm_dtype=comm_dtype, seed=seed)
     head, head_slots = head_push(
         mesh, hs.head, hs.head_slots, rows, grads, access, lr,
-        layout="packed", comm_dtype=comm_dtype, seed=seed)
+        layout="packed", comm_dtype=comm_dtype, seed=seed, zero=zero)
     return HybridTableState(head=head, head_slots=head_slots, tail=tail), dropped
 
 
@@ -388,7 +425,8 @@ def pull_hybrid_packed_small(mesh: Mesh, hs: HybridTableState,
 def push_hybrid_packed_small(mesh: Mesh, hs: HybridTableState,
                              rows: jax.Array, grads: jax.Array,
                              access: AccessMethod, lr, dim: int,
-                             comm_dtype: str = "float32", seed=None):
+                             comm_dtype: str = "float32", seed=None,
+                             zero: bool = False):
     from swiftsnails_tpu.parallel.store import small_group
 
     g = small_group(dim)
@@ -400,5 +438,6 @@ def push_hybrid_packed_small(mesh: Mesh, hs: HybridTableState,
         comm_dtype=comm_dtype, seed=seed)
     head, head_slots = head_push(
         mesh, hs.head, hs.head_slots, rows, grads, access, lr,
-        layout="small", dim=dim, group=g, comm_dtype=comm_dtype, seed=seed)
+        layout="small", dim=dim, group=g, comm_dtype=comm_dtype, seed=seed,
+        zero=zero)
     return HybridTableState(head=head, head_slots=head_slots, tail=tail)

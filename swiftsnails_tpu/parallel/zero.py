@@ -1,0 +1,212 @@
+"""ZeRO-style optimizer-state sharding across the data axis.
+
+``optimizer_sharding: zero`` implements the weight-update sharding of
+*Automatic Cross-Replica Sharding of Weight Update Computation*
+(arXiv 2004.13336) for every plane this system replicates across the data
+axis today:
+
+* the dense-model optimizer state (the optax AdaGrad ``sum_of_squares``
+  pytree in the CTR trainers) — pure redundancy: every data shard holds
+  the same accumulators and applies the same update;
+* the hybrid head's optimizer-slot planes (``HybridTableState.head_slots``,
+  the dense AdaGrad ``accum`` prefix) — same redundancy, same fix.
+
+The mechanism is placement, not layout: a sharded plane keeps its logical
+shape and is ``jax.device_put`` to ``P("data")`` so each replica holds a
+``1/data`` leading-axis slice resident in HBM. The update is then applied
+shard-local — the hybrid head reduce-scatters the summed gradient
+(:func:`~swiftsnails_tpu.parallel.comm.reduce_scatter_quantized`), updates
+its owned slice, and all-gathers only the param slice back; the dense
+update is steered by ``with_sharding_constraint`` so GSPMD partitions the
+elementwise optimizer math instead of replicating it. Because logical
+values are unchanged and ``np.asarray`` on a sharded array materializes
+the full plane, checkpoints stay byte-identical to the unsharded format
+(:class:`ZeroManager.master_state` additionally commits planes back to
+replicated placement before a manifest is built, mirroring
+``PlacementManager.master_state``).
+
+``ZeroManager`` mirrors the PlacementManager surface (active / adopt /
+master_state / summary) so TrainLoop, checkpointing, and resume integrate
+the same way the hybrid split does.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+OPTIMIZER_SHARDING_MODES = ("none", "zero")
+
+
+def resolve_optimizer_sharding(name: Optional[str]) -> str:
+    name = (name or "none").lower()
+    if name not in OPTIMIZER_SHARDING_MODES:
+        raise ValueError(
+            f"unknown optimizer_sharding {name!r}; expected one of "
+            f"{OPTIMIZER_SHARDING_MODES}")
+    return name
+
+
+def zero_plane_spec(arr, data: int):
+    """PartitionSpec for one optimizer-plane leaf, or None to leave it.
+
+    A leaf is shardable when its leading axis splits evenly across the
+    ``data`` axis; scalars (optax step counts) and ragged planes stay
+    replicated. The same predicate steers both the resident placement
+    (``adopt``) and the in-jit ``with_sharding_constraint`` so they can
+    never disagree.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    shape = getattr(arr, "shape", None)
+    if not shape or len(shape) < 1:
+        return None
+    if shape[0] < data or shape[0] % data:
+        return None
+    return P("data")
+
+
+def _leaf_nbytes(leaf) -> int:
+    shape = getattr(leaf, "shape", ())
+    dt = np.dtype(getattr(leaf, "dtype", np.float32))
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dt.itemsize
+
+
+class ZeroManager:
+    """ZeRO plane lifecycle over the trainer's zero/tier hooks.
+
+    ``adopt`` reshards the trainer-declared optimizer planes (and any
+    hybrid head slot planes) from replicated to ``P("data")`` after
+    init/restore/placement-adopt; ``master_state`` commits them back to
+    replicated placement (the only placement checkpoint manifests and
+    end-of-run consumers ever see). Both are value-preserving device_puts.
+    """
+
+    def __init__(self, trainer, mesh=None):
+        self.trainer = trainer
+        self.mesh = mesh if mesh is not None else getattr(trainer, "mesh", None)
+        self.mode = resolve_optimizer_sharding(
+            getattr(trainer, "optimizer_sharding", "none"))
+        self.decision: Dict = {}
+
+    @property
+    def data(self) -> int:
+        from swiftsnails_tpu.parallel.mesh import DATA_AXIS
+
+        return int(self.mesh.shape[DATA_AXIS]) if self.mesh is not None else 1
+
+    @property
+    def active(self) -> bool:
+        return self.mode == "zero" and self.mesh is not None
+
+    # ---------------------------------------------------------------- adopt
+
+    def _put(self, leaf, spec):
+        import jax
+        from jax.sharding import NamedSharding
+
+        return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+    def adopt(self, state):
+        """Reshard every eligible replicated plane to ``P("data")``."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        if not self.active:
+            return state
+        data = self.data
+        sharded = replicated = 0
+        planes = 0
+
+        def reshard(leaf):
+            nonlocal sharded, replicated, planes
+            spec = zero_plane_spec(leaf, data)
+            nb = _leaf_nbytes(leaf)
+            if spec is None:
+                return leaf
+            planes += 1
+            replicated += nb
+            sharded += nb // data
+            return self._put(leaf, spec)
+
+        opt = self.trainer.zero_planes(state)
+        if opt is not None:
+            state = self.trainer.zero_with_planes(
+                state, jax.tree_util.tree_map(reshard, opt))
+
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
+
+        tables = self.trainer.tier_tables(state)
+        new = {}
+        for name, ts in tables.items():
+            if not is_hybrid(ts) or not ts.head_slots:
+                continue
+            slots = {k: reshard(v) for k, v in ts.head_slots.items()}
+            new[name] = ts._replace(head_slots=slots)
+        if new:
+            state = self.trainer.tier_with_tables(state, new)
+
+        self.decision = {
+            "mode": self.mode,
+            "devices": data,
+            "planes": planes,
+            "replicated_bytes": int(replicated),
+            "sharded_bytes_per_replica": int(sharded),
+            "reduction": (float(replicated) / float(sharded)
+                          if sharded else 1.0),
+        }
+        if planes:
+            log.info(
+                "zero: sharded %d optimizer plane(s) across data=%d "
+                "(%d -> %d bytes/replica)", planes, data, replicated, sharded)
+        return state
+
+    # --------------------------------------------------------- master_state
+
+    def master_state(self, state):
+        """Commit planes back to replicated placement (merge-before-manifest).
+
+        Values are unchanged (sharding is placement, not layout) — this
+        step pins the *placement* contract: whatever consumes the master
+        state (manifest build, serving export, the end-of-run eval) sees
+        exactly the unsharded resident layout it would have seen without
+        ``optimizer_sharding``, mirroring ``PlacementManager.master_state``.
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        if not self.active:
+            return state
+
+        def unshard(leaf):
+            if zero_plane_spec(leaf, self.data) is None:
+                return leaf
+            return self._put(leaf, P())
+
+        opt = self.trainer.zero_planes(state)
+        if opt is not None:
+            state = self.trainer.zero_with_planes(
+                state, jax.tree_util.tree_map(unshard, opt))
+
+        from swiftsnails_tpu.parallel.hybrid import is_hybrid
+
+        tables = self.trainer.tier_tables(state)
+        new = {}
+        for name, ts in tables.items():
+            if not is_hybrid(ts) or not ts.head_slots:
+                continue
+            new[name] = ts._replace(
+                head_slots={k: unshard(v) for k, v in ts.head_slots.items()})
+        if new:
+            state = self.trainer.tier_with_tables(state, new)
+        return state
+
+    def summary(self) -> Dict:
+        return dict(self.decision)

@@ -16,6 +16,15 @@ result — and ``-done`` halves of async pairs never match (the op name must
 be followed by ``(`` or ``-start(``). For async starts that define a tuple,
 the traffic-carrying shape is taken as the largest tuple element (the
 result; operand aliases and ``u32[]`` context scalars are smaller).
+
+Reduce-scatter is the one family whose DEFINING shape understates the
+wire: the sync form's result is the 1/N owned slice of the summed operand,
+so billing the result alone undercounts the traffic N-fold (every element
+of the full operand crosses the interconnect exactly as in an all-reduce's
+reduce phase). For ``reduce-scatter``/``all-reduce-scatter`` the billed
+bytes are therefore the max shape atom across the instruction's operand
+list as well as its result, with the same dtype-exact sub-byte rule
+(``(n*bits+7)//8``) as everywhere else.
 """
 
 from __future__ import annotations
@@ -62,6 +71,16 @@ _SHAPE_ATOM_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
 _OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
 _SCOPE_RE = re.compile(r"(ssn_[\w\-.]+)")
 
+# ops whose defining shape is a 1/N slice of the moved payload: bill the
+# operand list too (sync reduce-scatter results understate traffic N-fold)
+_FULL_OPERAND_OPS = frozenset({"reduce-scatter", "all-reduce-scatter"})
+
+# ops whose tuple result IS the payload, one element per peer: XLA lowers a
+# tiled shard_map all-to-all to "(T[n,..]{..}, ...) all-to-all(T[n,..] a, ...)"
+# with axis_size equal pieces — max-element billing would undercount the
+# moved buffer axis_size-fold, so these sum every tuple element instead
+_SUM_TUPLE_OPS = frozenset({"all-to-all", "ragged-all-to-all"})
+
 
 def _atom_bytes(dtype: str, dims: str) -> int:
     bits = _DTYPE_BITS.get(dtype)
@@ -78,6 +97,12 @@ def _shape_bytes(shape: str) -> int:
     if not atoms:
         return 0
     return max(_atom_bytes(dt, dims) for dt, dims in atoms)
+
+
+def _shape_bytes_sum(shape: str) -> int:
+    """Bytes summed over every shape atom (per-peer tuple pieces)."""
+    return sum(_atom_bytes(dt, dims) for dt, dims in
+               _SHAPE_ATOM_RE.findall(shape))
 
 
 def collective_stats(hlo_text: str) -> Dict:
@@ -105,6 +130,20 @@ def collective_stats(hlo_text: str) -> Dict:
             continue
         nbytes = _shape_bytes(m.group("shape"))
         op = m.group("op")
+        if op in _SUM_TUPLE_OPS:
+            nbytes = _shape_bytes_sum(m.group("shape"))
+            if m.group("start"):
+                # async start tuples carry operand aliases next to the
+                # results; summing both would double-bill the payload
+                nbytes //= 2
+        if op in _FULL_OPERAND_OPS:
+            # operand shapes sit inside the call parens; stop before the
+            # metadata blob so op_name strings can't smuggle in fake atoms
+            tail = line[m.end():]
+            cut = tail.find("metadata=")
+            if cut != -1:
+                tail = tail[:cut]
+            nbytes = max(nbytes, _shape_bytes(tail))
         entry = ops.setdefault(op, {"count": 0, "bytes": 0})
         entry["count"] += 1
         entry["bytes"] += nbytes

@@ -589,6 +589,45 @@ def render_report(ledger: Ledger) -> str:
                         + f"  loss_delta={row.get('loss_delta')}"
                     )
 
+    # sharded optimizer state: bench records carrying the zero lane's HBM
+    # census + grad-reduce exchange + parity block
+    zero_rows = [
+        (r.get("ts", "?"), r["payload"]["zero"])
+        for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("zero"), dict)
+        and not r["payload"]["zero"].get("skipped")
+    ]
+    if zero_rows:
+        lines.append("")
+        lines.append("sharded optimizer state (zero; newest last):")
+        for ts, z in zero_rows[-5:]:
+            hbm = z.get("hbm") or {}
+            gr = z.get("grad_reduce") or {}
+            red = hbm.get("reduction")
+            lines.append(
+                f"  {ts}  devices={z.get('n_devices')} "
+                f"(data={(z.get('mesh') or {}).get('data')})  "
+                f"hbm/replica={_fmt_num(hbm.get('replicated_bytes', 0))}B"
+                f"->{_fmt_num(hbm.get('sharded_bytes_per_replica', 0))}B  "
+                "reduction="
+                + (f"{red:.2f}x" if isinstance(red, (int, float)) else "n/a")
+            )
+            lines.append(
+                f"    grad reduce: psum={_fmt_num(gr.get('baseline_bytes', 0))}B"
+                f"  zero={_fmt_num(gr.get('zero_bytes', 0))}B  "
+                f"loss_parity={z.get('loss_parity_f32')}  "
+                f"ckpt_identical={z.get('checkpoint_identical')}"
+            )
+            ov = z.get("overlap")
+            if isinstance(ov, dict):
+                split = ov.get("step_split_est") or {}
+                lines.append(
+                    f"    overlap2: {_fmt_num(ov.get('aggregate_words_per_sec', 0))} words/s "
+                    f"({ov.get('speedup_vs_sequential')}x vs sequential)  "
+                    f"collective_frac={split.get('collective_frac')}"
+                )
+
     outages = ledger.records("outage")
     if outages:
         lines.append("")
@@ -916,9 +955,12 @@ def check_regression(
         w_rc, w_msg = _check_profiler_overhead_regression(ledger)
         if w_msg:
             msg = f"{msg}\n{w_msg}"
+        z_rc, z_msg = _check_zero_regression(ledger)
+        if z_msg:
+            msg = f"{msg}\n{z_msg}"
         return max(
             2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-            o_rc, d_rc, w_rc), msg
+            o_rc, d_rc, w_rc, z_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -964,9 +1006,12 @@ def check_regression(
             w_rc, w_msg = _check_profiler_overhead_regression(ledger)
             if w_msg:
                 msg = f"{msg}\n{w_msg}"
+            z_rc, z_msg = _check_zero_regression(ledger)
+            if z_msg:
+                msg = f"{msg}\n{z_msg}"
             return max(
                 0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-                o_rc, d_rc, w_rc), msg
+                o_rc, d_rc, w_rc, z_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -1019,9 +1064,12 @@ def check_regression(
     w_rc, w_msg = _check_profiler_overhead_regression(ledger)
     if w_msg:
         msg = f"{msg}\n{w_msg}"
+    z_rc, z_msg = _check_zero_regression(ledger)
+    if z_msg:
+        msg = f"{msg}\n{z_msg}"
     return max(
         rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-        o_rc, d_rc, w_rc), msg
+        o_rc, d_rc, w_rc, z_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -1167,6 +1215,76 @@ def _check_quantized_wire_regression(
     return 0, (
         f"int4-wire ok: exchange bytes {red:.2f}x below f32 "
         f"(floor {_INT4_PAYLOAD_FLOOR:.1f}x), loss parity {parity}"
+    )
+
+
+# the zero lane must keep its replicated-plane HBM win (per-replica bytes
+# of the optimizer/parameter planes, >= 2x at >= 2 data shards), keep the
+# dense-grad reduce's audited exchange no larger than the psum baseline,
+# hold f32 loss parity, and its checkpoints must stay byte-identical to the
+# unsharded run's (correctness — any platform gates, hard fail)
+_ZERO_HBM_FLOOR = 2.0
+_ZERO_LOSS_PARITY_MAX = 0.01
+
+
+def _check_zero_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the sharded-optimizer-state lane (``optimizer_sharding: zero``).
+
+    The newest bench record carrying a populated ``zero`` block must show:
+    replicated-plane HBM per replica reduced >= ``_ZERO_HBM_FLOOR`` when the
+    lane ran on >= 2 data shards; audited dense-grad-reduce bytes no larger
+    than the psum baseline (compiled-HLO shapes, platform-independent);
+    f32 loss parity within ``_ZERO_LOSS_PARITY_MAX``; and
+    ``checkpoint_identical`` true — a sharded run whose checkpoint differs
+    from the unsharded format is a hard fail on ANY platform (restore
+    compatibility is the lane's core contract). No zero history gates
+    nothing."""
+    with_zero = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("zero"), dict)
+        and not r["payload"]["zero"].get("skipped")
+    ]
+    if not with_zero:
+        return 0, None
+    z = with_zero[-1]["payload"]["zero"]
+    problems = []
+    hbm = z.get("hbm") or {}
+    red = hbm.get("reduction")
+    mesh_data = (z.get("mesh") or {}).get("data")
+    if isinstance(mesh_data, int) and mesh_data >= 2:
+        if not (isinstance(red, (int, float)) and red >= _ZERO_HBM_FLOOR):
+            problems.append(
+                f"replicated-plane HBM reduction {red} at data={mesh_data} "
+                f"is below the {_ZERO_HBM_FLOOR:.1f}x floor")
+    gr = z.get("grad_reduce") or {}
+    zb, bb = gr.get("zero_bytes"), gr.get("baseline_bytes")
+    if isinstance(zb, (int, float)) and isinstance(bb, (int, float)):
+        if zb > bb:
+            problems.append(
+                f"dense-grad reduce exchange {zb:,.0f} B exceeds the psum "
+                f"baseline {bb:,.0f} B")
+    parity = z.get("loss_parity_f32")
+    if not (isinstance(parity, (int, float))
+            and parity <= _ZERO_LOSS_PARITY_MAX):
+        problems.append(
+            f"f32 loss parity {parity} vs unsharded exceeds the "
+            f"{_ZERO_LOSS_PARITY_MAX} bar")
+    if z.get("checkpoint_identical") is not True:
+        problems.append(
+            "checkpoint is NOT byte-identical to the unsharded run's "
+            f"(checkpoint_identical={z.get('checkpoint_identical')!r})")
+    if problems:
+        return 1, "zero-sharding REGRESSION: " + "; ".join(problems)
+    wire = (
+        f"grad reduce {zb:,.0f} B <= psum {bb:,.0f} B"
+        if isinstance(zb, (int, float)) and isinstance(bb, (int, float))
+        else "grad reduce bytes n/a"
+    )
+    return 0, (
+        f"zero-sharding ok: HBM {red}x/replica at data={mesh_data} "
+        f"(floor {_ZERO_HBM_FLOOR:.1f}x), {wire}, loss parity {parity}, "
+        "checkpoints byte-identical"
     )
 
 

@@ -148,6 +148,49 @@ def test_collective_stats_dtype_aware():
     assert st["ops"]["all-gather"]["bytes"] == 32 * 4 * 2
 
 
+def test_reduce_scatter_bills_full_operand():
+    """A sync reduce-scatter's result is the 1/N scattered slice; the wire
+    moved the FULL operand, so billing must take the operand side."""
+    hlo = ("%rs = f32[16,8]{1,0} reduce-scatter(f32[64,8]{1,0} %x), "
+           "channel_id=4, metadata={op_name=\"jit(step)/ssn_zero_head_push"
+           "/psum_scatter\"}")
+    st = collective_stats(hlo)
+    assert st["ops"]["reduce-scatter"] == {"count": 1, "bytes": 64 * 8 * 4}
+    assert st["by_scope"] == {"ssn_zero_head_push": 64 * 8 * 4}
+
+
+def test_reduce_scatter_sub_byte_operand():
+    # int4 wire: (n * bits + 7) // 8, measured on the full operand
+    hlo = "%rs = u4[16,8]{1,0} reduce-scatter(u4[64,8]{1,0} %x), channel_id=4"
+    st = collective_stats(hlo)
+    assert st["ops"]["reduce-scatter"]["bytes"] == (64 * 8 * 4 + 7) // 8
+
+
+def test_all_to_all_tuple_sums_pieces():
+    """Tiled shard_map all_to_all lowers to the tuple form with axis_size
+    operand/result pieces — the bill is the sum, not the max element."""
+    hlo = ("%a2a = (f32[8,4]{1,0}, f32[8,4]{1,0}, f32[8,4]{1,0}, "
+           "f32[8,4]{1,0}) all-to-all(f32[8,4]{1,0} %p0, f32[8,4]{1,0} %p1, "
+           "f32[8,4]{1,0} %p2, f32[8,4]{1,0} %p3), channel_id=5")
+    st = collective_stats(hlo)
+    assert st["ops"]["all-to-all"] == {"count": 1, "bytes": 4 * 8 * 4 * 4}
+
+
+def test_all_to_all_async_start_not_double_billed():
+    """-start forms carry operand AND result aliases in one tuple; the
+    halving keeps async traffic equal to the sync form's."""
+    sync = ("%a = (f32[8,4]{1,0}, f32[8,4]{1,0}) all-to-all("
+            "f32[8,4]{1,0} %p0, f32[8,4]{1,0} %p1), channel_id=6")
+    asyn = ("%s = ((f32[8,4]{1,0}, f32[8,4]{1,0}), (f32[8,4]{1,0}, "
+            "f32[8,4]{1,0})) all-to-all-start(f32[8,4]{1,0} %p0, "
+            "f32[8,4]{1,0} %p1), channel_id=6\n"
+            "%d = (f32[8,4]{1,0}, f32[8,4]{1,0}) all-to-all-done(%s)")
+    st_sync = collective_stats(sync)
+    st_asyn = collective_stats(asyn)
+    assert st_sync["ops"]["all-to-all"]["bytes"] == 2 * 8 * 4 * 4
+    assert st_asyn["ops"]["all-to-all"] == st_sync["ops"]["all-to-all"]
+
+
 # ------------------------------------- audit of a real sharded step -------
 
 
@@ -202,6 +245,30 @@ def test_compiled_collective_bytes_kernel_lab_contract():
     kl = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(kl)
     assert kl._compiled_collective_bytes(step, args, "all-reduce") == ar_only
+
+
+def test_audit_compiled_reduce_scatter_full_operand():
+    """End to end on real compiled HLO: an f32 reduce_scatter_quantized
+    step bills the full operand under its ssn_zero scope label."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from swiftsnails_tpu.parallel.comm import reduce_scatter_quantized
+
+    mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2})
+    rows, dim = 64, 8
+
+    def step(x):
+        def body(xs):
+            with jax.named_scope("ssn_zero_head_push"):
+                return reduce_scatter_quantized(xs[0], DATA_AXIS, "float32", 4)
+
+        return shard_map(body, mesh=mesh, in_specs=(P(DATA_AXIS),),
+                         out_specs=P(DATA_AXIS), check_rep=False)(x)
+
+    report = audit_step(step, jnp.ones((4, rows, dim), jnp.float32))
+    assert report["ops"]["reduce-scatter"]["bytes"] == rows * dim * 4
+    assert report["by_scope"].get("ssn_zero_head_push", 0) == rows * dim * 4
 
 
 def test_audit_single_device_no_collectives():

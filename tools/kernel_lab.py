@@ -509,7 +509,8 @@ def dedup_traffic_lab():
         bs)
 
     def coll_bytes(fn, *args):
-        return _compiled_collective_bytes(fn, args, "all-gather|all-reduce")
+        return _compiled_collective_bytes(
+            fn, args, "all-gather|all-reduce|reduce-scatter|all-to-all")
 
     plain_pull = lambda s, r: pull_collective_packed(mesh, s, r)
     plain_push = lambda s, r, g: push_collective_packed(

@@ -20,7 +20,12 @@ derived view of it. This tool renders the history — and gates CI:
     # tiered/resident ratio has a hard 0.95x floor), and the fleet lane:
     # p99 over the SLO, 2-replica scaling under the floor, affinity not
     # beating random, or hedging not cutting p99 is fatal on any
-    # platform; fleet max QPS gates per platform
+    # platform; fleet max QPS gates per platform. The zero lane
+    # (optimizer_sharding: zero) gates too: replicated-plane HBM per
+    # replica must stay >=2x reduced at >=2 data shards, the dense-grad
+    # reduce's audited bytes must not exceed the psum baseline, f32 loss
+    # parity must hold, and a checkpoint that is not byte-identical to
+    # the unsharded format fails on any platform
     python tools/ledger_report.py --check-regression 10
 
     # failure timeline: outage / chaos-injection / black-box / checkpoint
