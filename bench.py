@@ -137,7 +137,8 @@ _state = {
     "drift": None,  # training-plane drift drill (dict; --lane drift)
     "profile_overhead": None,  # continuous profiler on-vs-off cost (--lane drift)
     "zero": None,  # sharded-optimizer-state lane (dict; see --lane zero)
-    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness | drift | zero)
+    "net": None,  # TCP serving/liveness/delta-stream lane (dict; --lane net)
+    "lane": "full",  # which lane emitted this line (full | chaos | serve | tiered | chaos-serve | chaos-cluster | freshness | drift | zero | net)
     "copies_per_pair": {},  # grouped/resident kernel row-copy census
     "best_overrides": None,  # headline path's trainer config overrides
     "attempted": set(),  # paths that ran to completion OR failed (not skipped)
@@ -253,6 +254,7 @@ def _result_json(extra_error=None):
             "drift": _state["drift"],
             "profile_overhead": _state["profile_overhead"],
             "zero": _state["zero"],
+            "net": _state["net"],
             "lane": _state["lane"],
             "comm_audit": _state["comm_audit"],
             "goodput": _state["goodput"],
@@ -1671,6 +1673,80 @@ def run_freshness_lane() -> int:
     return 0 if ok else 1
 
 
+# -- net (TCP serving + liveness + delta streaming) lane -----------------------
+#
+# `--lane net` runs the transport lane (`swiftsnails_tpu/net/`): the same
+# checkpoint served by an in-process fleet (control), by a NetFleet of two
+# spawned `replica_server` processes over the SSD1 stream RPC (p99 envelope +
+# pull bit parity over the wire), and by the same TCP fleet under a fault
+# storm — a mid-load SIGKILL recovered via lease expiry -> drain -> respawn ->
+# rejoin with availability >= 99%, a partition whose stale write is refused
+# typed on heal, and a TCP delta-stream publisher kill reconverging to bit
+# parity 0.0. Correctness is platform-independent, so the lane is valid on
+# CPU; the block lands in the result JSON (`net`), the run ledger, and the
+# `ledger-report --check-regression` gate.
+
+
+def measure_net() -> None:
+    """Populate ``_state['net']`` with the transport lane block."""
+    from swiftsnails_tpu.net.bench_lane import net_bench
+    from swiftsnails_tpu.telemetry.ledger import Ledger
+
+    block = net_bench(small=_SMALL, ledger=Ledger(LEDGER_PATH))
+    _state["net"] = block
+    print(
+        f"bench: net lane: p99 tcp {block.get('p99_tcp_ms')}ms vs local "
+        f"{block.get('p99_local_ms')}ms ({block.get('envelope_x'):.1f}x, "
+        f"limit {block.get('envelope_limit_x')}x) "
+        f"availability {block.get('availability_pct')}% "
+        f"proc_kill recovered "
+        f"{(block.get('proc_kill') or {}).get('recovered')} "
+        f"stale write refused "
+        f"{(block.get('partition') or {}).get('stale_write_refused')} "
+        f"delta parity {(block.get('delta') or {}).get('parity')}",
+        file=sys.stderr,
+    )
+
+
+def run_net_lane() -> int:
+    """``--lane net``: the transport lane alone, one JSON line."""
+    from swiftsnails_tpu.utils.platform_pin import repin_from_env
+
+    repin_from_env()
+    import jax
+
+    _state["lane"] = "net"
+    _state["platform"] = jax.devices()[0].platform
+    try:
+        measure_net()
+    except Exception as e:
+        _state["errors"].append(
+            f"net lane failed ({type(e).__name__}: {e})")
+        _emit_once()
+        return 1
+    block = _state["net"]
+    # the lane's headline is transport correctness + availability, not a
+    # rate — leave the perf headline empty and gate on the lane's own pass
+    # criteria (mirrored by _check_net_regression)
+    _state["best_path"] = "net"
+    _save_last_good()  # ledger record (never cacheable as the perf headline)
+    _emit_once()
+    pk = block.get("proc_kill") or {}
+    pt = block.get("partition") or {}
+    dl = block.get("delta") or {}
+    ok = (
+        block.get("tcp_parity") == 0.0
+        and pk.get("recovered")
+        and (pk.get("availability_pct") or 0.0)
+        >= block.get("availability_floor_pct", 99.0)
+        and pt.get("stale_write_refused")
+        and dl.get("parity") == 0.0
+        and (block.get("envelope_x") or 0.0)
+        <= block.get("envelope_limit_x", 0.0)
+    )
+    return 0 if ok else 1
+
+
 # -- training-plane drift drill + profiler-overhead lane -----------------------
 #
 # `--lane drift` runs the observability drill (`swiftsnails_tpu/telemetry/
@@ -2412,7 +2488,7 @@ def main(argv=None):
         "--lane",
         choices=("full", "scaling", "chaos", "serve", "fleet", "tiered",
                  "chaos-serve", "chaos-cluster", "freshness", "drift",
-                 "zero"),
+                 "zero", "net"),
         default="full",
         help="full = the headline bench (default); scaling = the scale-out "
              "lane alone (grouped-mesh 1-vs-N throughput per comm_dtype plus "
@@ -2447,7 +2523,13 @@ def main(argv=None):
              "psum exchange bytes, f32 loss parity + checkpoint "
              "byte-identity vs unsharded, overlap: 2 goodput ride-along; "
              "bytes/parity are compiled shapes + bit checks, so valid on "
-             "CPU)",
+             "CPU); net = the TCP serving lane (three legs: in-process "
+             "control vs a TCP fleet of spawned replica_server processes "
+             "vs the same fleet under a proc_kill/net_partition/publisher-"
+             "kill fault storm — availability through a SIGKILL'd replica, "
+             "lease-expiry drain + respawn + rejoin, stale-write refusal "
+             "on partition heal, TCP delta-stream bit parity, and the "
+             "TCP-vs-in-process p99 envelope; valid on CPU)",
     )
     args = parser.parse_args(argv)
     watchdog = threading.Timer(BENCH_DEADLINE_S - (time.monotonic() - _T0), _deadline)
@@ -2473,6 +2555,8 @@ def main(argv=None):
         return run_drift_lane()
     if args.lane == "zero":
         return run_zero_lane()
+    if args.lane == "net":
+        return run_net_lane()
 
     from swiftsnails_tpu.data.sampler import batch_stream, skipgram_pairs
 

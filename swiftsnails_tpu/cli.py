@@ -14,7 +14,12 @@ Usage::
     python -m swiftsnails_tpu serve  -config train.conf -checkpoint ROOT   # query REPL
     python -m swiftsnails_tpu serve  ... -replicas 4   # replica fleet behind the router
     # in the serve REPL: `subscribe <dir>` follows the trainer's live
-    # hot-row delta log (freshness pipeline, docs/FRESHNESS.md)
+    # hot-row delta log (freshness pipeline, docs/FRESHNESS.md);
+    # `subscribe tcp://HOST:PORT` streams it over a socket instead
+    # (docs/NETWORK.md) — the trainer side sets `freshness_listen`
+    python -m swiftsnails_tpu net-serve --root ROOT --listen HOST:PORT
+    #   one replica process serving pull/topk/score/health over TCP
+    #   (the multi-host fleet's unit; spawned by net.fleet.ReplicaSpawner)
     python -m swiftsnails_tpu models
     python -m swiftsnails_tpu trace-summary TRACE_OR_JSONL   # telemetry breakdown
     python -m swiftsnails_tpu ledger-report [LEDGER.jsonl]   # run-ledger history
@@ -130,7 +135,7 @@ def cmd_serve(argv: List[str]) -> int:
         ops                          one-screen dashboard (SLO / traces)
         add                          (fleet) add a replica to the ring
         drain <replica>              (fleet) drain + remove a replica
-        subscribe <dir>              follow a hot-row delta log (freshness)
+        subscribe <dir|tcp://h:p>    follow a hot-row delta log (freshness)
         freshness                    applied-seq watermark / lag / fallbacks
         quit
 
@@ -168,6 +173,7 @@ def cmd_serve(argv: List[str]) -> int:
         server_cm = Servant.from_checkpoint(
             root, cfg, mesh=_serve_mesh(cfg), ledger=ledger)
     subscriber = None
+    delta_source = None
     with server_cm as servant:
         if fleet_mode:
             banner = (f"serving fleet of {replicas} replicas "
@@ -225,20 +231,50 @@ def cmd_serve(argv: List[str]) -> int:
 
                     if subscriber is not None:
                         subscriber.stop()
-                    subscriber = DeltaSubscriber(
-                        servant, args[0], config=cfg,
-                        checkpoint_root=root,
-                        max_lag_ms=cfg.get_float("freshness_max_lag_ms", 0.0),
-                        ledger=ledger)
-                    found = subscriber.subscribe()
-                    subscriber.start()
-                    servant.attach_freshness(subscriber)
-                    out = {"subscribed": args[0], "stream_open": found}
+                    if delta_source is not None:
+                        delta_source.stop()
+                        delta_source = None
+                    target = args[0]
+                    if target.startswith("tcp://"):
+                        # socket-fed: the TCP source drives apply_batch;
+                        # the subscriber never polls a local directory
+                        # (docs/NETWORK.md) — base adoption, gap detection
+                        # and the fallback ladder are unchanged
+                        from swiftsnails_tpu.net.delta_stream import (
+                            TcpDeltaSource)
+
+                        host, _, port = target[len("tcp://"):].rpartition(":")
+                        subscriber = DeltaSubscriber(
+                            servant, cfg.get_str("freshness_dir", "")
+                            or root + ".deltas", config=cfg,
+                            checkpoint_root=root,
+                            max_lag_ms=cfg.get_float(
+                                "freshness_max_lag_ms", 0.0),
+                            ledger=ledger)
+                        delta_source = TcpDeltaSource(
+                            subscriber, host, int(port), config=cfg,
+                            ledger=ledger).start()
+                        servant.attach_freshness(subscriber)
+                        out = {"subscribed": target, "stream_open": True}
+                    else:
+                        subscriber = DeltaSubscriber(
+                            servant, target, config=cfg,
+                            checkpoint_root=root,
+                            max_lag_ms=cfg.get_float(
+                                "freshness_max_lag_ms", 0.0),
+                            ledger=ledger)
+                        found = subscriber.subscribe()
+                        subscriber.start()
+                        servant.attach_freshness(subscriber)
+                        out = {"subscribed": target, "stream_open": found}
                 elif op == "freshness":
                     if subscriber is None:
-                        out = {"error": "not subscribed (use: subscribe <dir>)"}
+                        out = {"error": "not subscribed (use: subscribe "
+                               "<dir> or subscribe tcp://HOST:PORT)"}
                     else:
                         out = subscriber.status()
+                        if delta_source is not None:
+                            out["source"] = delta_source.status()
                 else:
                     out = {"error": f"unknown op {op!r}"}
             except Overloaded as e:
@@ -248,6 +284,8 @@ def cmd_serve(argv: List[str]) -> int:
             except Exception as e:  # noqa: BLE001 — a REPL must not die
                 out = {"error": f"{type(e).__name__}: {e}"}
             print(json.dumps(out), flush=True)
+        if delta_source is not None:
+            delta_source.stop()
         if subscriber is not None:
             subscriber.stop()
         print(json.dumps({"final_stats": servant.stats()}), flush=True)
@@ -281,6 +319,16 @@ def cmd_ops(argv: List[str]) -> int:
     from swiftsnails_tpu.telemetry.ops import main as ops_main
 
     return ops_main(argv)
+
+
+def cmd_net_serve(argv: List[str]) -> int:
+    """One replica process serving a checkpoint over TCP (docs/NETWORK.md):
+    pull/topk/score/health RPCs behind the SSD1 frame codec, spawnable by
+    hand here or by ``net.fleet.ReplicaSpawner``; prints one JSON ready
+    line (``{"port": ..., "incarnation": ...}``) and serves until killed."""
+    from swiftsnails_tpu.net.replica_server import main as replica_main
+
+    return replica_main(argv)
 
 
 def cmd_supervisor_status(argv: List[str]) -> int:
@@ -340,12 +388,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             return cmd_supervisor_status(rest)
         if cmd == "ops":
             return cmd_ops(rest)
+        if cmd == "net-serve":
+            return cmd_net_serve(rest)
         if cmd in ("master", "server"):
             print(_ROLE_NOTE.format(role=cmd), file=sys.stderr)
             return 0
         print(
             f"unknown command {cmd!r}; try: train, export, serve, models, "
-            "trace-summary, ledger-report, supervisor-status, ops",
+            "trace-summary, ledger-report, supervisor-status, ops, "
+            "net-serve",
             file=sys.stderr,
         )
         return 2

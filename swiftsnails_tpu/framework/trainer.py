@@ -793,6 +793,7 @@ class TrainLoop:
             # so subscribers reach the final training watermark without
             # waiting for a full checkpoint cycle
             self.freshness.maybe_publish(state, step, force=True)
+            self.freshness.close()
         if tier is not None:
             # end-of-run write-back: flush every dirty cache slot and hand
             # the caller the full-size master-backed state (same pytree type,

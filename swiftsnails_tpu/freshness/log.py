@@ -155,23 +155,32 @@ def read_batch(path: str) -> Tuple[Dict, Dict[str, Dict[str, np.ndarray]]]:
             blob = f.read()
     except OSError as e:
         raise DeltaCorrupt(f"{path}: unreadable ({e})") from e
+    return decode_batch(blob, label=path)
+
+
+def decode_batch(
+    blob: bytes, label: str = "<stream>",
+) -> Tuple[Dict, Dict[str, Dict[str, np.ndarray]]]:
+    """Decode one batch blob (file contents or a TCP frame payload) ->
+    ``(header, tables)``; :class:`DeltaCorrupt` on any framing/CRC failure.
+    ``label`` names the source in error messages."""
     if len(blob) < len(MAGIC) + 8 or not blob.startswith(MAGIC):
-        raise DeltaCorrupt(f"{path}: bad magic/short file")
+        raise DeltaCorrupt(f"{label}: bad magic/short file")
     hlen = int(np.frombuffer(blob[4:8], np.uint32)[0])
     body_end = len(blob) - 4
     if 8 + hlen > body_end:
-        raise DeltaCorrupt(f"{path}: truncated header")
+        raise DeltaCorrupt(f"{label}: truncated header")
     stored = int(np.frombuffer(blob[body_end:], np.uint32)[0])
     if (zlib.crc32(blob[8:body_end]) & 0xFFFFFFFF) != stored:
-        raise DeltaCorrupt(f"{path}: CRC mismatch")
+        raise DeltaCorrupt(f"{label}: CRC mismatch")
     try:
         header = json.loads(blob[8 : 8 + hlen].decode("utf-8"))
     except ValueError as e:
-        raise DeltaCorrupt(f"{path}: unparseable header") from e
+        raise DeltaCorrupt(f"{label}: unparseable header") from e
     dtype = header.get("dtype", "float32")
     val_dt = _VAL_DTYPES.get(dtype)
     if val_dt is None:
-        raise DeltaCorrupt(f"{path}: unknown dtype {dtype!r}")
+        raise DeltaCorrupt(f"{label}: unknown dtype {dtype!r}")
     payload = blob[8 + hlen : body_end]
     tables: Dict[str, Dict[str, np.ndarray]] = {}
     for entry in header.get("tables", []):
@@ -181,7 +190,7 @@ def read_batch(path: str) -> Tuple[Dict, Dict[str, Dict[str, np.ndarray]]]:
         need = off + rows_nb + vals_nb + (
             n * _SCALE_DTYPE.itemsize if dtype == "int8" else 0)
         if need > len(payload):
-            raise DeltaCorrupt(f"{path}: payload shorter than header claims")
+            raise DeltaCorrupt(f"{label}: payload shorter than header claims")
         rows = np.frombuffer(payload, _ROW_DTYPE, count=n, offset=off)
         values = np.frombuffer(
             payload, val_dt, count=n * dim, offset=off + rows_nb,

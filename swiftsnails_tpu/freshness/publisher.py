@@ -36,8 +36,20 @@ from typing import Dict, Iterable, List, Optional, Tuple
 import numpy as np
 
 from swiftsnails_tpu.freshness.log import prune, write_base, write_batch
+from swiftsnails_tpu.utils.config import ConfigError
 
 _LEDGER_EVERY = 100  # rate limit: first publish + every 100th
+
+
+class HybridFreshnessError(ConfigError):
+    """``placement: hybrid`` + freshness publishing + a TCP delta stream
+    (``freshness_listen``) don't compose: hybrid head/tail planes leave
+    the master row layout mid-run, so published rows would carry the
+    wrong id space — and with a socket listener configured, remote
+    subscribers would be *silently* starved if we just disabled
+    publishing (the local-file case keeps the old disable-with-notice
+    behavior, where the operator sees the stderr line). Raised at
+    TrainLoop construction, before any step runs."""
 
 
 # ------------------------------------------------- normalized row gathers ---
@@ -329,11 +341,22 @@ class TrainPublisher:
         if self.active and placement is not None:
             # hybrid head/tail planes aren't in master row layout mid-run;
             # publishing would ship rows from the wrong id space
+            listen = cfg.get_str("freshness_listen", "")
+            if listen:
+                raise HybridFreshnessError(
+                    "placement: hybrid cannot be combined with freshness "
+                    "publishing to a TCP delta stream (freshness_listen="
+                    f"{listen!r}): hybrid planes leave master row layout "
+                    "mid-run, and remote subscribers would be silently "
+                    "starved. Drop freshness_listen (file-dir publishing "
+                    "is disabled with a notice) or drop placement: hybrid.")
             import sys
 
             print("freshness: publishing disabled under hybrid placement "
                   "(planes leave master layout mid-run)", file=sys.stderr)
             self.active = False
+        self.listen = cfg.get_str("freshness_listen", "")
+        self.stream_server = None
         self.pub: Optional[DeltaPublisher] = None
         self.collector: Optional[TouchedRowCollector] = None
         self._tap: Dict[str, List[np.ndarray]] = {}
@@ -351,6 +374,15 @@ class TrainPublisher:
             self.dir, base_step=base_step, dtype=self.dtype,
             log_mb=self.log_mb, ledger=self.ledger,
             request_tracer=self.request_tracer)
+        if self.listen:
+            # freshness_listen: HOST:PORT — push this log's frames to TCP
+            # subscribers (net/delta_stream.py) alongside the file dir
+            from swiftsnails_tpu.net.delta_stream import DeltaStreamServer
+
+            host, _, port = self.listen.rpartition(":")
+            self.stream_server = DeltaStreamServer(
+                self.dir, host=host or "127.0.0.1", port=int(port or 0),
+                ledger=self.ledger).start()
         if self.tier is not None and not self.tier.all_transparent:
             # dirty-flush tee: every landed write-back records its units
             for name, tt in self.tier.tables.items():
@@ -359,6 +391,13 @@ class TrainPublisher:
             # resident (or transparent-tier: identity slot map, raw-id
             # batches, live full planes) — collect touched rows per step
             self.collector = TouchedRowCollector(self.trainer)
+
+    def close(self) -> None:
+        """End the incarnation: stop the TCP stream server (if any); the
+        delta files stay for file-poll subscribers and resubscribes."""
+        if self.stream_server is not None:
+            self.stream_server.stop()
+            self.stream_server = None
 
     # -- per-step hooks ------------------------------------------------------
 

@@ -126,15 +126,39 @@ class DeltaSubscriber:
             base = read_base(self.dir)
             if base is None:
                 return False
+            seqs = list_seqs(self.dir)
+            self.adopt_base(base, first_seq=seqs[0] if seqs else None)
+            return True
+
+    def adopt_base(self, base: Dict,
+                   first_seq: Optional[int] = None) -> None:
+        """Adopt a publisher base record — the transport-agnostic half of
+        :meth:`subscribe` (a TCP stream source delivers the base as a frame
+        instead of a ``BASE.json`` read). ``first_seq`` overrides the base's
+        own ``first_seq`` when the transport knows the oldest batch it can
+        still deliver."""
+        with self._lock:
             self.publisher = base.get("publisher")
             self.base_step = int(base.get("base_step", 0) or 0)
-            seqs = list_seqs(self.dir)
-            self.next_seq = seqs[0] if seqs else int(
-                base.get("first_seq", 1) or 1)
+            self.next_seq = int(first_seq if first_seq is not None
+                                else base.get("first_seq", 1) or 1)
             # everything the target already serves needs no replay
             self.floor_step = max(self.floor_step,
                                   int(getattr(self.target, "step", 0) or 0))
-            return True
+
+    def corrupt_fallback(self, failed_seq: Optional[int] = None) -> None:
+        """Public CRC-failure entry for alternate transports: a stream
+        source that decodes a corrupt batch falls back exactly like the
+        file poll does."""
+        with self._lock:
+            self._fallback("crc", failed_seq=failed_seq)
+
+    def restart_fallback(self) -> None:
+        """Public restart entry for alternate transports: a stream source
+        that observes a new publisher incarnation (its base frame changed
+        under it) falls back exactly like the file poll does."""
+        with self._lock:
+            self._fallback("restart")
 
     # -- polling -------------------------------------------------------------
 

@@ -77,6 +77,11 @@ FAULT_KINDS = (
     # TrainLoop *outside* the traced step span, so the stall lands in the
     # host-blocked decomposition bucket exactly like a real host stall
     "slow_step",
+    # process-level transport kinds (PR 19): consulted by the net drills,
+    # scheduled by storm tick. proc_kill SIGKILLs a replica process
+    # mid-load; net_partition black-holes its socket for a window;
+    # net_slow injects RTT into every reply (see net/bench_lane.py)
+    "proc_kill", "net_partition", "net_slow",
 )
 
 _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<first>\d+)(?:-(?P<last>\d+))?$")
@@ -385,6 +390,16 @@ class ChaosPlan:
         order. The caller picks the victim and ``_log``s the detail (the
         plan can't know worker identities)."""
         return [kind for kind in ("worker_dead", "worker_slow", "partition")
+                if self._take(kind, tick)]
+
+    # -- process-level transport faults (consulted by the net drills;
+    # "step" is the storm tick) ----------------------------------------------
+
+    def net_fault(self, tick: int) -> List[str]:
+        """The transport faults scheduled at storm tick ``tick``, in fire
+        order. The caller picks the victim replica/socket and ``_log``s the
+        detail (the plan can't know process identities)."""
+        return [kind for kind in ("proc_kill", "net_partition", "net_slow")
                 if self._take(kind, tick)]
 
     def wants_reload_corrupt(self, index: int) -> bool:

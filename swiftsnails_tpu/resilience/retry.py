@@ -158,12 +158,14 @@ class RetryPolicy:
     # -- the loop -------------------------------------------------------------
 
     def call(self, fn: Callable, *args, op: str = "operation",
-             on_retry: Optional[Callable] = None, **kwargs):
+             on_retry: Optional[Callable] = None,
+             extra: Optional[dict] = None, **kwargs):
         """Run ``fn(*args, **kwargs)`` under this policy. Exceptions matching
         ``retry_on`` are retried with backoff until the attempt budget or the
         deadline runs out; anything else propagates immediately. Exhaustion
         raises :class:`RetryExhausted` (or :class:`DeadlineExceeded`) and —
-        when a ledger is attached — appends a ``retry_exhausted`` event."""
+        when a ledger is attached — appends a ``retry_exhausted`` event
+        (``extra`` fields, e.g. a peer address, merge into that record)."""
         budget = self.budget()
         deadline = self.deadline()
         started = self.clock()
@@ -177,27 +179,33 @@ class RetryPolicy:
                 last = e
                 elapsed_ms = (self.clock() - started) * 1e3
                 if budget.exhausted:
-                    self._give_up(op, budget.used, elapsed_ms, "attempts", e)
+                    self._give_up(op, budget.used, elapsed_ms, "attempts", e,
+                                  extra)
                 backoff = self.next_backoff_s(backoff)
                 if deadline.remaining() < backoff:
-                    self._give_up(op, budget.used, elapsed_ms, "deadline", e)
+                    self._give_up(op, budget.used, elapsed_ms, "deadline", e,
+                                  extra)
                 if on_retry is not None:
                     on_retry(e, budget.used, backoff)
                 self.sleep(backoff)
 
     def _give_up(self, op: str, attempts: int, elapsed_ms: float,
-                 reason: str, err: BaseException) -> None:
+                 reason: str, err: BaseException,
+                 extra: Optional[dict] = None) -> None:
         exc_cls = DeadlineExceeded if reason == "deadline" else RetryExhausted
         exc = exc_cls(op, attempts, elapsed_ms, reason, err)
         if self.ledger is not None:
             try:
-                self.ledger.append("retry_exhausted", {
+                record = {
                     "op": op,
                     "attempts": attempts,
                     "elapsed_ms": round(elapsed_ms, 3),
                     "reason": reason,
                     "error": f"{type(err).__name__}: {err}",
-                })
+                }
+                if extra:
+                    record.update(extra)
+                self.ledger.append("retry_exhausted", record)
             except Exception:
                 pass  # bookkeeping never fails the failure path
         raise exc from err

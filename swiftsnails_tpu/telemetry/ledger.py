@@ -657,7 +657,7 @@ def render_report(ledger: Ledger) -> str:
 FAILURE_KINDS = ("outage", "chaos", "blackbox", "cache_error", "overload",
                  "retry_exhausted", "breaker", "degraded", "membership",
                  "hedge", "drain", "freshness_gap", "slo_burn",
-                 "trace_anomaly", "drift", "scale_hint")
+                 "trace_anomaly", "drift", "scale_hint", "transport")
 
 
 def _failure_line(r: Dict) -> str:
@@ -796,6 +796,34 @@ def _failure_line(r: Dict) -> str:
             f"  {ts}  SCALE-HINT source={r.get('source')} "
             f"kernels={','.join(kerns) if isinstance(kerns, list) else kerns}"
         )
+    if kind == "transport":
+        # the TCP layer's connection timeline (net/rpc.py clients, the
+        # delta stream source, and the replica manager's drain/respawn) —
+        # interleaves with membership/breaker lines so one read shows a
+        # replica die, get declared lost, drained, and rejoin
+        event = r.get("event", "?")
+        who = r.get("replica") or r.get("peer", "?")
+        if event == "conn_lost":
+            return (f"  {ts}  CONN-LOST    {who}  peer={r.get('peer')}  "
+                    f"{str(r.get('error', ''))[:70]}")
+        if event == "reconnect":
+            return (f"  {ts}  RECONNECT    {who}  peer={r.get('peer')}  "
+                    f"reconnects={r.get('reconnects')}")
+        if event == "drained":
+            return (f"  {ts}  DRAINED      {r.get('replica')}  "
+                    f"pid={r.get('pid')}")
+        if event == "respawn":
+            return (f"  {ts}  RESPAWN      {r.get('replica')} -> "
+                    f"{r.get('replacement')}  "
+                    f"incarnation={r.get('incarnation')}  "
+                    f"pid={r.get('pid')}")
+        if event == "proc_kill":
+            return (f"  {ts}  PROC-KILL    {who}  pid={r.get('pid')}")
+        if event == "partition":
+            return (f"  {ts}  PARTITION    {who}  "
+                    f"duration={_fmt_num(r.get('duration_ms', 0))}ms")
+        extra = f"  source={r.get('source')}" if r.get("source") else ""
+        return f"  {ts}  TRANSPORT    {event} {who}{extra}"
     if kind == "membership":
         # the cluster supervisor's lifecycle timeline (cluster/supervisor.py)
         action = r.get("action", "?")
@@ -887,6 +915,20 @@ def render_failures(ledger: Ledger) -> str:
                 f"serve_p99={c.get('serve_p99_ms')}ms "
                 f"gap_recovered={gap.get('recovered')}"
             )
+        elif kind == "bench" and isinstance(r.get("payload"), dict) \
+                and isinstance(r["payload"].get("net"), dict):
+            c = r["payload"]["net"]
+            pk = c.get("proc_kill") or {}
+            dl = c.get("delta") or {}
+            lines.append(
+                f"  {r.get('ts', '?')}  bench    net lane: "
+                f"availability={c.get('availability_pct')}% "
+                f"tcp_parity={c.get('tcp_parity')} "
+                f"delta_parity={dl.get('parity')} "
+                f"envelope={c.get('envelope_x')}x "
+                f"respawns={c.get('respawns')} "
+                f"kill_recovered={pk.get('recovered')}"
+            )
     if shown == 0:
         lines.append("  (no failure events recorded)")
     return "\n".join(lines)
@@ -958,9 +1000,12 @@ def check_regression(
         z_rc, z_msg = _check_zero_regression(ledger)
         if z_msg:
             msg = f"{msg}\n{z_msg}"
+        e_rc, e_msg = _check_net_regression(ledger)
+        if e_msg:
+            msg = f"{msg}\n{e_msg}"
         return max(
             2, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-            o_rc, d_rc, w_rc, z_rc), msg
+            o_rc, d_rc, w_rc, z_rc, e_rc), msg
     newest = measured[-1]["payload"]["value"]
     if baseline is None:
         earlier = [r["payload"]["value"] for r in measured[:-1]]
@@ -1009,9 +1054,12 @@ def check_regression(
             z_rc, z_msg = _check_zero_regression(ledger)
             if z_msg:
                 msg = f"{msg}\n{z_msg}"
+            e_rc, e_msg = _check_net_regression(ledger)
+            if e_msg:
+                msg = f"{msg}\n{e_msg}"
             return max(
                 0, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-                o_rc, d_rc, w_rc, z_rc), msg
+                o_rc, d_rc, w_rc, z_rc, e_rc), msg
         baseline = max(earlier)
     floor = baseline * (1.0 - max_drop_pct / 100.0)
     if newest < floor:
@@ -1067,9 +1115,12 @@ def check_regression(
     z_rc, z_msg = _check_zero_regression(ledger)
     if z_msg:
         msg = f"{msg}\n{z_msg}"
+    e_rc, e_msg = _check_net_regression(ledger)
+    if e_msg:
+        msg = f"{msg}\n{e_msg}"
     return max(
         rc, s_rc, c_rc, v_rc, f_rc, t_rc, a_rc, k_rc, p_rc, q_rc, n_rc,
-        o_rc, d_rc, w_rc, z_rc), msg
+        o_rc, d_rc, w_rc, z_rc, e_rc), msg
 
 
 def _scaling_value(record: Dict) -> Optional[float]:
@@ -1335,6 +1386,66 @@ def _check_freshness_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
         f"freshness ok: bit parity {parity}, lag p99 "
         f"{_fmt_num(lag)}ms (ceiling {_fmt_num(ceiling)}ms), serve p99 "
         f"{_fmt_num(p99)}ms (SLO {_fmt_num(slo)}ms), gap drill recovered"
+    )
+
+
+def _check_net_regression(ledger: Ledger) -> Tuple[int, Optional[str]]:
+    """Gate the net lane: the newest bench record carrying a ``net`` block
+    must show availability at/over the floor through a SIGKILL'd replica
+    with the lost -> drain -> respawn -> rejoin arc completing, a refused
+    stale write on partition heal, bit parity 0.0 for both the TCP read
+    path and the post-publisher-kill delta stream (correctness — any
+    platform gates), and TCP serving p99 within the recorded envelope of
+    the same run's in-process p99 (same platform by construction, so it
+    gates anywhere too). No net history gates nothing."""
+    with_net = [
+        r for r in ledger.records("bench")
+        if isinstance(r.get("payload"), dict)
+        and isinstance(r["payload"].get("net"), dict)
+    ]
+    if not with_net:
+        return 0, None
+    n = with_net[-1]["payload"]["net"]
+    problems = []
+    avail = n.get("availability_pct")
+    floor = n.get("availability_floor_pct", 99.0)
+    if not (isinstance(avail, (int, float)) and avail >= floor):
+        problems.append(
+            f"availability {avail}% under proc_kill is below the "
+            f"{floor}% floor")
+    pk = n.get("proc_kill") or {}
+    if not pk.get("recovered"):
+        problems.append(
+            "proc_kill drill did not recover (lost -> drain -> respawn "
+            "-> rejoin arc incomplete)")
+    pt = n.get("partition") or {}
+    if not pt.get("stale_write_refused"):
+        problems.append(
+            "partitioned replica ACCEPTED a stale write on heal")
+    tcp_parity = n.get("tcp_parity")
+    if not (isinstance(tcp_parity, (int, float)) and tcp_parity == 0.0):
+        problems.append(
+            f"TCP-pulled rows are not bit-identical to the reference "
+            f"(parity={tcp_parity})")
+    dl = n.get("delta") or {}
+    d_parity = dl.get("parity")
+    if not (isinstance(d_parity, (int, float)) and d_parity == 0.0):
+        problems.append(
+            f"post-publisher-kill delta parity {d_parity} != 0.0")
+    env = n.get("envelope_x")
+    limit = n.get("envelope_limit_x")
+    if (isinstance(env, (int, float)) and isinstance(limit, (int, float))
+            and limit > 0 and env > limit):
+        problems.append(
+            f"TCP serving p99 is {env:.1f}x in-process "
+            f"(envelope {limit:.0f}x)")
+    if problems:
+        return 1, "net REGRESSION: " + "; ".join(problems)
+    return 0, (
+        f"net ok: availability {_fmt_num(avail)}% through proc_kill "
+        f"(floor {_fmt_num(floor)}%), stale write refused on heal, TCP "
+        f"parity {tcp_parity}, delta parity {d_parity}, envelope "
+        f"{_fmt_num(env)}x (limit {_fmt_num(limit)}x)"
     )
 
 
@@ -1957,7 +2068,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument(
         "--check-regression", type=float, metavar="PCT", default=None,
         help="exit nonzero if the newest measured bench value is more than "
-             "PCT%% below the pinned baseline (bench gate mode)",
+             "PCT%% below the pinned baseline (bench gate mode); also "
+             "gates the correctness lanes on any platform — chaos "
+             "recovery, freshness bit parity, and the net lane "
+             "(availability through proc_kill, stale-write refusal on "
+             "partition heal, TCP/delta parity, p99 envelope)",
     )
     p.add_argument(
         "--baseline", type=float, default=None,
@@ -1972,7 +2087,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument(
         "--failures", action="store_true",
         help="render the failure timeline (outage/chaos/blackbox/"
-             "cache_error events next to run records) instead of the "
+             "cache_error/transport events next to run records — "
+             "CONN-LOST / PARTITION / PROC-KILL / RECONNECT interleaved "
+             "with the membership and breaker lines) instead of the "
              "full report",
     )
     p.add_argument(

@@ -6,14 +6,16 @@ Two renderers over the same layout, one per vantage point:
   :class:`~swiftsnails_tpu.serving.engine.Servant` or
   :class:`~swiftsnails_tpu.serving.fleet.Fleet` ``stats()``/``health()``
   snapshot): per-replica traffic split, p50/p99, cache hit rate, breaker
-  and degraded state, the SLO tracker's burn rates and error budget, the
+  and degraded state — plus, for a TCP ``NetFleet``, each replica's
+  transport state (connected / reconnecting / drained) — the SLO
+  tracker's burn rates and error budget, the
   freshness watermark/lag, and the most recent anomaly traces (each line
   names a ``trace_id`` the request tracer can still produce in full). The
   serve REPL's ``ops`` op prints this.
 * :func:`render_ops_from_ledger` — the **offline** view reconstructed
   from a run ledger: the newest fleet bench block's per-replica numbers
-  and tracing-overhead leg, the newest freshness lane, and the recent
-  ``slo_burn`` / ``trace_anomaly`` / ``freshness_gap`` event tail.
+  and tracing-overhead leg, the newest freshness and net lanes, and the
+  recent ``slo_burn`` / ``trace_anomaly`` / ``freshness_gap`` event tail.
   ``python -m swiftsnails_tpu ops`` (or ``tools/ops_report.py``) prints
   this.
 
@@ -39,9 +41,14 @@ def _fmt(v: Any, nd: int = 2) -> str:
 
 
 def _replica_rows(per_replica: Dict[str, Dict]) -> List[str]:
+    # a NetFleet's rows carry the TCP client state per replica
+    # (connected / reconnecting / drained) — show the column only then,
+    # so in-process fleets keep their narrow table
+    net = any(isinstance(rs, dict) and "transport" in rs
+              for rs in per_replica.values())
     lines = [
         "  replica  state    requests  p50_ms   p99_ms   hit     "
-        "breakers"
+        + ("transport     " if net else "") + "breakers"
     ]
     for rid, rs in sorted(per_replica.items()):
         # live fleet.stats() nests latencies under kernels.pull; the bench
@@ -55,11 +62,12 @@ def _replica_rows(per_replica: Dict[str, Dict]) -> List[str]:
             btxt = "-"
         hit = rs.get("cache_hit_rate")
         qps = rs.get("qps")
+        ttxt = f"{str(rs.get('transport', '-')):<13} " if net else ""
         lines.append(
             f"  {rid:<8} {str(rs.get('state', '-')):<8} "
             f"{_fmt(qps, 1) + '/s' if qps is not None else _fmt(rs.get('requests')):<9} "
             f"{_fmt(kern.get('p50_ms')):<8} {_fmt(kern.get('p99_ms')):<8} "
-            f"{_fmt(hit, 3):<7} {btxt}"
+            f"{_fmt(hit, 3):<7} {ttxt}{btxt}"
         )
     return lines
 
@@ -219,6 +227,32 @@ def render_ops_from_ledger(ledger) -> str:
         )
     else:
         lines.append("freshness lane: (no freshness bench record)")
+    net_recs = [r for r in benches
+                if isinstance(r["payload"].get("net"), dict)]
+    if net_recs:
+        nb = net_recs[-1]["payload"]["net"]
+        pk = nb.get("proc_kill") or {}
+        dl = nb.get("delta") or {}
+        lines.append(
+            f"net lane: availability={nb.get('availability_pct')}% "
+            f"(floor {nb.get('availability_floor_pct')}%) "
+            f"tcp_p99={nb.get('p99_tcp_ms')}ms "
+            f"({_fmt(nb.get('envelope_x'))}x in-process, "
+            f"limit {_fmt(nb.get('envelope_limit_x'), 0)}x) "
+            f"respawns={nb.get('respawns')} "
+            f"kill_recovered={_fmt(pk.get('recovered'))} "
+            f"delta_parity={dl.get('parity')}"
+        )
+        transports = ledger.records("transport")
+        if transports:
+            lines.append(
+                f"  transport events: {len(transports)} "
+                f"(newest {transports[-1].get('ts', '?')} "
+                f"{transports[-1].get('event')}; "
+                "drill with ledger-report --failures)"
+            )
+    else:
+        lines.append("net lane: (no net bench record)")
     burns = ledger.records("slo_burn")
     if burns:
         newest = burns[-1]

@@ -1,0 +1,215 @@
+"""Freshness delta subscription over TCP (ISSUE 19): the stream source
+must keep EVERY semantics the file poll has — bit parity, publisher-
+restart fallback, corrupt-batch fallback that resumes PAST the dead
+batch — plus the hybrid-placement x ``freshness_listen`` config guard
+(a typed error, not silently starved remote subscribers)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.freshness.log import seg_path
+from swiftsnails_tpu.freshness.publisher import (
+    DeltaPublisher,
+    HybridFreshnessError,
+    TrainPublisher,
+)
+from swiftsnails_tpu.freshness.subscriber import DeltaSubscriber
+from swiftsnails_tpu.net.delta_stream import DeltaStreamServer, TcpDeltaSource
+from swiftsnails_tpu.utils.config import Config, ConfigError
+
+DIM = 8
+CAP = 64
+
+
+def _vals(rows, seed):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((len(rows), DIM)).astype(np.float32)
+
+
+class FakeTarget:
+    """The apply_rows / reload_from_checkpoint / step / version surface
+    the subscriber drives (same shape as tests/test_freshness.py)."""
+
+    def __init__(self, cap=CAP, dim=DIM):
+        self.tables = {"t": np.zeros((cap, dim), np.float32)}
+        self.step = 0
+        self.version = 0
+        self.reloads = 0
+
+    def apply_rows(self, updates, *, version=None, step=None):
+        for name, (rows, vals) in updates.items():
+            self.tables[name][np.asarray(rows, np.int64)] = np.asarray(
+                vals, np.float32)
+        if step is not None:
+            self.step = max(self.step, int(step))
+        self.version = int(version) if version is not None \
+            else self.version + 1
+        return self.version
+
+    def reload_from_checkpoint(self, root, config, **kw):
+        self.reloads += 1
+        self.version += 1
+        return self.version
+
+
+def _cfg():
+    return Config({
+        "net_connect_timeout_ms": "300", "net_read_timeout_ms": "250",
+        "retry_max_attempts": "3", "retry_deadline_ms": "2000",
+        "retry_base_ms": "2", "retry_cap_ms": "15",
+    })
+
+
+def _wait(cond, timeout=8.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _stream(tmp_path, **sub_kw):
+    d = str(tmp_path / "log")
+    os.makedirs(d, exist_ok=True)
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d, config=_cfg(), **sub_kw)
+    srv = DeltaStreamServer(d).start()
+    src = TcpDeltaSource(sub, *srv.address, config=_cfg())
+    return d, tgt, sub, srv, src
+
+
+def test_tcp_stream_applies_batches_bit_identically(tmp_path):
+    d, tgt, sub, srv, src = _stream(tmp_path)
+    pub = DeltaPublisher(d, base_step=0)
+    rows = np.array([3, 0, 17, CAP - 1], np.int64)
+    vals1, vals2 = _vals(rows, 1), _vals(rows, 2)
+    pub.publish({"t": (rows, vals1)}, 1)
+    src.start()
+    assert _wait(lambda: sub.applied_seq >= 1)
+    np.testing.assert_array_equal(tgt.tables["t"][rows], vals1)
+    # a batch published AFTER the source connected streams through too
+    pub.publish({"t": (rows, vals2)}, 2)
+    assert _wait(lambda: sub.applied_seq >= 2)
+    np.testing.assert_array_equal(tgt.tables["t"][rows], vals2)
+    assert sub.publisher == pub.id and sub.fallbacks == 0
+    st = src.status()
+    assert st["state"] == "connected" and st["batches"] >= 2
+    src.stop()
+    srv.stop()
+
+
+def test_publisher_restart_mid_stream_falls_back_then_adopts(tmp_path):
+    d, tgt, sub, srv, src = _stream(tmp_path, checkpoint_root="ck")
+    a = DeltaPublisher(d, base_step=1)
+    rows = np.arange(4, dtype=np.int64)
+    a.publish({"t": (rows, _vals(rows, 1))}, 2)
+    src.start()
+    assert _wait(lambda: sub.applied_seq >= 1)
+    assert sub.publisher == a.id
+    # the publisher dies and respawns: new incarnation, renumbered stream
+    b = DeltaPublisher(d, base_step=5)
+    new_vals = _vals(rows, 9)
+    b.publish({"t": (rows, new_vals)}, 6)
+    assert _wait(lambda: sub.publisher == b.id and sub.applied_batches >= 2)
+    assert sub.fallbacks >= 1 and tgt.reloads >= 1
+    assert _wait(lambda: tgt.step >= 6)
+    np.testing.assert_array_equal(tgt.tables["t"][rows], new_vals)
+    src.stop()
+    srv.stop()
+
+
+def test_corrupt_batch_falls_back_past_the_dead_seq(tmp_path):
+    d = str(tmp_path / "log")
+    pub = DeltaPublisher(d, base_step=0)
+    rows = np.arange(4, dtype=np.int64)
+    vals3 = _vals(rows, 3)
+    pub.publish({"t": (rows, _vals(rows, 1))}, 1)
+    pub.publish({"t": (rows, _vals(rows, 2))}, 2)
+    pub.publish({"t": (rows, vals3)}, 3)
+    # flip one bit mid-segment: the stream ships the bytes verbatim, the
+    # subscriber-side CRC must catch it and fall back
+    path = seg_path(d, 2)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0x40
+    open(path, "wb").write(bytes(blob))
+    tgt = FakeTarget()
+    sub = DeltaSubscriber(tgt, d, config=_cfg(), checkpoint_root="ck")
+    srv = DeltaStreamServer(d).start()
+    src = TcpDeltaSource(sub, *srv.address, config=_cfg()).start()
+    # seq 1 applies, seq 2 is corrupt -> reload + resume PAST it at seq 3
+    assert _wait(lambda: sub.applied_seq >= 3)
+    assert sub.fallbacks == 1 and tgt.reloads == 1
+    np.testing.assert_array_equal(tgt.tables["t"][rows], vals3)
+    src.stop()
+    srv.stop()
+
+
+# -- the hybrid-placement x freshness_listen guard (config validation) -------
+
+
+class _FakeTrainer:
+    def __init__(self, cfg):
+        self.config = cfg
+
+    def table_geometry(self):
+        return {"t": {"layout": "dense", "group": 1, "dim": DIM,
+                      "capacity": CAP}}
+
+
+def test_hybrid_plus_tcp_stream_is_a_typed_config_error(tmp_path):
+    cfg = Config({
+        "freshness_publish": "10",
+        "freshness_dir": str(tmp_path / "log"),
+        "freshness_listen": "127.0.0.1:0",
+    })
+    with pytest.raises(HybridFreshnessError) as ei:
+        TrainPublisher(_FakeTrainer(cfg), placement=object())
+    assert isinstance(ei.value, ConfigError)  # config plane, typed
+    assert "freshness_listen" in str(ei.value)
+    assert "hybrid" in str(ei.value)
+
+
+def test_hybrid_without_listener_still_disables_with_a_notice(tmp_path,
+                                                              capsys):
+    cfg = Config({
+        "freshness_publish": "10",
+        "freshness_dir": str(tmp_path / "log"),
+    })
+    tp = TrainPublisher(_FakeTrainer(cfg), placement=object())
+    assert tp.active is False  # old behavior: local operator sees stderr
+    assert "hybrid" in capsys.readouterr().err
+
+
+def test_freshness_listen_starts_and_stops_a_stream_server(tmp_path):
+    cfg = Config({
+        "freshness_publish": "5",
+        "freshness_dir": str(tmp_path / "log"),
+        "freshness_listen": "127.0.0.1:0",
+    })
+    tp = TrainPublisher(_FakeTrainer(cfg))
+    assert tp.active
+    tp.open(base_step=1)
+    try:
+        assert tp.stream_server is not None
+        host, port = tp.stream_server.address
+        assert port > 0
+        # a subscriber can ride the trainer-side listener directly
+        tgt = FakeTarget()
+        sub = DeltaSubscriber(tgt, str(tmp_path / "log"), config=_cfg())
+        src = TcpDeltaSource(sub, host, port, config=_cfg()).start()
+        rows = np.arange(3, dtype=np.int64)
+        vals = _vals(rows, 4)
+        tp.pub.publish({"t": (rows, vals)}, 2)
+        assert _wait(lambda: sub.applied_seq >= 1)
+        np.testing.assert_array_equal(tgt.tables["t"][rows], vals)
+        src.stop()
+    finally:
+        tp.close()
+    assert tp.stream_server is None or tp.stream_server._stop.is_set()

@@ -1,0 +1,151 @@
+"""RPC transport robustness (ISSUE 19): every socket path under the
+retry policy, typed remote errors, transport-state bookkeeping, the
+``retry_exhausted`` / ``conn_lost`` / ``reconnect`` ledger trail, and the
+out-of-band chaos channel (``net_slow`` / ``net_partition``)."""
+
+import os
+import socket
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.net.rpc import (
+    RpcClient,
+    RpcRemoteError,
+    RpcServer,
+    net_retry_policy,
+)
+from swiftsnails_tpu.resilience.retry import RetryExhausted
+from swiftsnails_tpu.telemetry.ledger import Ledger, render_failures
+
+
+def _echo(header, payload):
+    return {"echo": header.get("x")}, payload[::-1]
+
+
+def _fast_policy(ledger=None, **kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("deadline_ms", 2_000.0)
+    kw.setdefault("base_ms", 2.0)
+    kw.setdefault("cap_ms", 10.0)
+    return net_retry_policy(ledger=ledger, **kw)
+
+
+def _client(addr, ledger=None, replica=None, **kw):
+    return RpcClient(addr[0], addr[1], policy=_fast_policy(ledger=ledger),
+                     connect_timeout_ms=300.0, read_timeout_ms=400.0,
+                     ledger=ledger, replica=replica, **kw)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_echo_round_trip_and_transport_state():
+    with RpcServer({"echo": _echo}).start() as server:
+        client = _client(server.address)
+        assert client.transport_state == "reconnecting"  # no socket yet
+        hdr, payload = client.call("echo", {"x": 5}, b"abc")
+        assert hdr["echo"] == 5 and payload == b"cba"
+        assert client.transport_state == "connected"
+        client.close()
+        assert client.transport_state == "drained"
+        # a drained client refuses typed, not with a hang
+        with pytest.raises(RpcRemoteError, match="closed"):
+            client.call("echo", {"x": 1})
+
+
+def test_remote_handler_error_is_typed_and_never_retried():
+    calls = []
+
+    def boom(header, payload):
+        calls.append(1)
+        raise ValueError("boom")
+
+    with RpcServer({"boom": boom}).start() as server:
+        client = _client(server.address)
+        with pytest.raises(RpcRemoteError) as ei:
+            client.call("boom")
+        # the remote exception type crosses the wire...
+        assert ei.value.kind == "ValueError" and "boom" in ei.value.message
+        # ...and an *answer* is never retried (it is not an outage)
+        assert len(calls) == 1
+        with pytest.raises(RpcRemoteError) as ei:
+            client.call("nope")
+        assert ei.value.kind == "UnknownOp"
+        client.close()
+
+
+def test_retry_exhaustion_lands_a_ledger_event_with_the_peer(tmp_path):
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    port = _free_port()  # nothing listening: every connect is refused
+    client = RpcClient("127.0.0.1", port,
+                       policy=_fast_policy(ledger=led),
+                       connect_timeout_ms=200.0, read_timeout_ms=200.0,
+                       ledger=led)
+    with pytest.raises(RetryExhausted):
+        client.call("ping")
+    ev = led.records("retry_exhausted")[-1]
+    assert ev["peer"] == f"127.0.0.1:{port}"
+    assert ev["op"] == "net.ping" and ev["attempts"] >= 2
+    client.close()
+
+
+def test_conn_lost_and_reconnect_transport_events(tmp_path):
+    led = Ledger(str(tmp_path / "l.jsonl"))
+    server = RpcServer({"echo": _echo}).start()
+    addr = server.address
+    client = _client(addr, ledger=led, replica="r0")
+    assert client.call("echo", {"x": 1})[0]["echo"] == 1
+    server.stop()
+    with pytest.raises(RetryExhausted):
+        client.call("echo", {"x": 2})
+    lost = [r for r in led.records("transport") if r["event"] == "conn_lost"]
+    assert lost and lost[0]["peer"] == f"{addr[0]}:{addr[1]}"
+    assert lost[0]["replica"] == "r0"
+    # a fresh listener on the same port: the client reconnects and says so
+    server2 = RpcServer({"echo": _echo}, host=addr[0], port=addr[1]).start()
+    try:
+        assert client.call("echo", {"x": 3})[0]["echo"] == 3
+        recon = [r for r in led.records("transport")
+                 if r["event"] == "reconnect"]
+        assert recon and recon[0]["reconnects"] >= 1
+        out = render_failures(led)
+        assert "CONN-LOST" in out and "RECONNECT" in out
+    finally:
+        client.close()
+        server2.stop()
+
+
+def test_chaos_channel_answers_mid_partition_then_heals():
+    with RpcServer({"echo": _echo}).start() as server:
+        client = _client(server.address)
+        hdr = client.call("chaos", {"partition_ms": 30_000.0})[0]
+        assert hdr["partitioned"] is True
+        # data ops are read and dropped: the client times out and gives up
+        with pytest.raises(RetryExhausted):
+            client.call("echo", {"x": 1}, read_timeout_ms=150.0)
+        # drill control is out-of-band: it still answers mid-partition
+        assert client.call("chaos", {})[0]["partitioned"] is True
+        assert client.call("chaos", {"partition_ms": 0.0}
+                           )[0]["partitioned"] is False
+        assert client.call("echo", {"x": 2})[0]["echo"] == 2
+        client.close()
+
+
+def test_injected_slow_delays_replies_but_keeps_them_correct():
+    with RpcServer({"echo": _echo}).start() as server:
+        client = _client(server.address)
+        client.call("chaos", {"slow_ms": 60.0})
+        t0 = time.monotonic()
+        assert client.call("echo", {"x": 9})[0]["echo"] == 9
+        assert (time.monotonic() - t0) >= 0.05
+        client.call("chaos", {"slow_ms": 0.0})
+        client.close()

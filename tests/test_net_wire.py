@@ -1,0 +1,202 @@
+"""SSD1 stream-frame hardening (ISSUE 19 satellite: frame codec).
+
+The wire module's hardening contract, drilled input by input: a truncated
+header, a truncated payload, a flipped CRC byte, and an oversize length
+prefix must each raise a *typed* error — and the oversize prefix must be
+rejected BEFORE any buffer is sized from it. Frames split across
+arbitrary ``recv`` boundaries decode identically to frames arriving
+whole, the payload array index is bounds-checked before ``np.frombuffer``
+touches the bytes, and a malformed frame costs one *connection*, never
+the server loop.
+"""
+
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swiftsnails_tpu.freshness.log import MAGIC
+from swiftsnails_tpu.net.rpc import RpcClient, RpcServer, net_retry_policy
+from swiftsnails_tpu.net.wire import (
+    MAX_HEADER_BYTES,
+    MAX_PAYLOAD_BYTES,
+    FrameError,
+    FrameTooLarge,
+    FrameTruncated,
+    decode_frame,
+    encode_frame,
+    pack_arrays,
+    read_frame,
+    unpack_arrays,
+)
+
+
+def _blob_reader(blob, chunk=None, asks=None):
+    """A ``recv(n)``-shaped callable over a byte blob; ``chunk`` caps each
+    read (partial-read simulation), ``asks`` records every requested n."""
+    pos = [0]
+
+    def recv(n):
+        if asks is not None:
+            asks.append(n)
+        take = n if chunk is None else min(n, chunk)
+        out = bytes(blob[pos[0]: pos[0] + take])
+        pos[0] += len(out)
+        return out
+
+    return recv
+
+
+HEADER = {"op": "pull", "id": 7, "table": "t"}
+PAYLOAD = bytes(range(256)) * 3
+
+
+def test_frame_round_trip_bit_identical():
+    blob = encode_frame(HEADER, PAYLOAD)
+    hdr, payload = decode_frame(blob)
+    assert payload == PAYLOAD
+    assert hdr["op"] == "pull" and hdr["id"] == 7
+    # the stream reader's read budget is stamped in automatically
+    assert hdr["payload_len"] == len(PAYLOAD)
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 3, 7, 64])
+def test_interleaved_partial_reads_decode_identically(chunk):
+    blob = encode_frame(HEADER, PAYLOAD)
+    hdr, payload = read_frame(_blob_reader(blob, chunk=chunk))
+    assert payload == PAYLOAD and hdr["op"] == "pull"
+
+
+def test_truncated_header_raises_typed():
+    blob = encode_frame(HEADER, PAYLOAD)
+    cut = len(MAGIC) + 4 + 3  # three bytes into the header JSON
+    with pytest.raises(FrameTruncated):
+        read_frame(_blob_reader(blob[:cut]))
+
+
+def test_truncated_payload_and_crc_raise_typed():
+    blob = encode_frame(HEADER, PAYLOAD)
+    with pytest.raises(FrameTruncated):
+        read_frame(_blob_reader(blob[: len(blob) - 4 - len(PAYLOAD) // 2]))
+    with pytest.raises(FrameTruncated):
+        read_frame(_blob_reader(blob[: len(blob) - 2]))  # mid-CRC
+
+
+def test_flipped_byte_anywhere_fails_the_crc():
+    blob = bytearray(encode_frame(HEADER, PAYLOAD))
+    blob[-1] ^= 0x01  # the CRC itself
+    with pytest.raises(FrameError, match="CRC"):
+        read_frame(_blob_reader(bytes(blob)))
+    blob = bytearray(encode_frame(HEADER, PAYLOAD))
+    blob[len(blob) // 2] ^= 0x40  # mid-payload
+    with pytest.raises(FrameError, match="CRC"):
+        read_frame(_blob_reader(bytes(blob)))
+
+
+def test_bad_magic_is_typed():
+    blob = b"XXXX" + encode_frame(HEADER, PAYLOAD)[4:]
+    with pytest.raises(FrameError, match="magic"):
+        read_frame(_blob_reader(blob))
+
+
+def test_oversize_header_prefix_rejected_before_allocation():
+    # a hostile 4-byte prefix claiming a gigabyte of header JSON: the
+    # reader must reject on the prefix alone, never sizing a read from it
+    blob = MAGIC + np.uint32(MAX_HEADER_BYTES + 1).tobytes() + b"\0" * 64
+    asks = []
+    with pytest.raises(FrameTooLarge, match="header length"):
+        read_frame(_blob_reader(blob, asks=asks))
+    assert max(asks) <= len(MAGIC) + 4  # only the prefix was ever requested
+
+
+def test_oversize_payload_len_rejected_before_payload_read():
+    import json
+    import zlib
+
+    hjson = json.dumps({"op": "x", "payload_len": MAX_PAYLOAD_BYTES + 1}
+                       ).encode()
+    crc = zlib.crc32(hjson) & 0xFFFFFFFF
+    blob = (MAGIC + np.uint32(len(hjson)).tobytes() + hjson
+            + np.uint32(crc).tobytes())
+    asks = []
+    with pytest.raises(FrameTooLarge, match="payload length"):
+        read_frame(_blob_reader(blob, asks=asks))
+    assert max(asks) <= max(len(MAGIC) + 4, len(hjson))
+
+
+def test_header_must_be_json_dict_with_payload_len():
+    import zlib
+
+    for hjson in (b"[1, 2]", b"not json", b"{\"op\": \"x\"}"):
+        crc = zlib.crc32(hjson) & 0xFFFFFFFF
+        blob = (MAGIC + np.uint32(len(hjson)).tobytes() + hjson
+                + np.uint32(crc).tobytes())
+        with pytest.raises(FrameError):
+            read_frame(_blob_reader(blob))
+
+
+def test_encode_refuses_oversize_before_building_the_frame():
+    with pytest.raises(FrameTooLarge):
+        encode_frame({"blob": "x" * (MAX_HEADER_BYTES + 1)})
+
+
+# -- typed arrays in the payload ---------------------------------------------
+
+
+def test_pack_unpack_arrays_round_trip():
+    arrays = {
+        "ids": np.array([3, 0, 17], np.int64),
+        "rows": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "codes": np.array([[1, -2], [3, 4]], np.int8),
+    }
+    index, payload = pack_arrays(arrays)
+    out = unpack_arrays(index, payload)
+    for name, a in arrays.items():
+        np.testing.assert_array_equal(out[name], a)
+        assert out[name].dtype == a.dtype
+
+
+def test_unpack_arrays_bounds_checked_before_frombuffer():
+    index, payload = pack_arrays({"a": np.arange(4, dtype=np.float32)})
+    # an index entry claiming bytes past the payload end
+    bad = [dict(index[0], shape=[1024])]
+    with pytest.raises(FrameError, match="claims"):
+        unpack_arrays(bad, payload)
+    with pytest.raises(FrameError, match="negative"):
+        unpack_arrays([dict(index[0], shape=[-1])], payload)
+    with pytest.raises(FrameError, match="bad array index"):
+        unpack_arrays([{"name": "a"}], payload)
+
+
+# -- a malformed frame costs one connection, never the server ----------------
+
+
+def test_server_loop_survives_garbage_frames():
+    calls = []
+
+    def ping(header, payload):
+        calls.append(header.get("id"))
+        return {"pong": True}, b""
+
+    with RpcServer({"ping": ping}) as server:
+        server.start()
+        host, port = server.address
+        # a raw connection spews garbage: that CONNECTION dies typed...
+        raw = socket.create_connection((host, port), timeout=2.0)
+        raw.sendall(b"GARBAGE-NOT-A-FRAME" * 8)
+        raw.close()
+        deadline = time.monotonic() + 5.0
+        while server.frame_errors == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.frame_errors >= 1
+        # ...and the accept loop keeps serving fresh connections
+        client = RpcClient(host, port, policy=net_retry_policy(
+            max_attempts=2, deadline_ms=2_000.0, base_ms=5.0, cap_ms=20.0))
+        hdr, _ = client.call("ping")
+        assert hdr["pong"] is True and calls
+        client.close()

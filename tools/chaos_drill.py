@@ -20,6 +20,7 @@ resumed run whose final loss matches an undisturbed one.
     python tools/chaos_drill.py --fleet    # the replica-fleet drill matrix
     python tools/chaos_drill.py --freshness  # the delta-pipeline drill matrix
     python tools/chaos_drill.py --drift    # the training-plane drift drill
+    python tools/chaos_drill.py --net      # the TCP transport drill matrix
 
 ``--serve`` runs the CPU-valid availability drill instead (the bench
 ``chaos-serve`` lane): a seeded fault matrix against a live Servant with
@@ -60,6 +61,18 @@ config/env fingerprint + kept traces), and the before/after ``--diff``
 attribution must name host-blocked as the dominant contributor — plus the
 continuous profiler's own overhead vs words/sec must clear the 3% gate
 (or the off leg's measured noise floor). Exit is nonzero on any miss.
+
+``--net`` runs the CPU-valid TCP transport drill matrix instead: the three
+transport chaos kinds (``proc_kill`` / ``net_partition`` / ``net_slow``,
+scheduled through the chaos-spec syntax) fired against REAL spawned
+``replica_server`` processes behind a :class:`NetFleet`. A SIGKILL'd
+replica must be declared lost by lease expiry, drained from the ring, and
+replaced by a respawn that rejoins with a fresh incarnation and serves; a
+black-holed replica must miss the partition-window epoch and, on heal,
+REFUSE the stale write typed (``StaleEpoch``) before resyncing; injected
+server-side slowness must surface as a bounded typed client deadline —
+never a hang — and clear on heal. Exit is nonzero on any unrecovered
+fault.
 
 ``--cluster`` runs the CPU-valid membership drill matrix instead (the bench
 ``chaos-cluster`` lane, one fault kind per drill): a simulated virtual-clock
@@ -227,6 +240,29 @@ def _drift_matrix(args) -> int:
     return 1 if failed else 0
 
 
+def _net_matrix(args) -> int:
+    from swiftsnails_tpu.net.bench_lane import net_chaos_drill
+
+    out = net_chaos_drill(small=True, workdir=args.workdir)
+    results = {k: v for k, v in out.items() if isinstance(v, dict)}
+    failed = [k for k, v in results.items() if not v.get("recovered")]
+    if args.json:
+        print(json.dumps({"results": results, "failed": failed}))
+    else:
+        width = max(len(k) for k in results)
+        for name, res in results.items():
+            status = "RECOVERED" if res.get("recovered") else "UNRECOVERED"
+            detail = ", ".join(
+                f"{k}={v}" for k, v in res.items()
+                if k != "recovered" and not isinstance(v, dict))
+            print(f"{name:<{width}}  {status:<11}  {detail}")
+        print(
+            f"{len(results) - len(failed)}/{len(results)} drills recovered"
+            + (f"; FAILED: {', '.join(failed)}" if failed else "")
+        )
+    return 1 if failed else 0
+
+
 def _cluster_matrix(args) -> int:
     from swiftsnails_tpu.cluster.chaos_lane import run_cluster_drills
 
@@ -289,6 +325,12 @@ def main(argv=None) -> int:
                         "(publisher kill / corrupt delta / forced gap vs a "
                         "subscribed fleet; each must fall back to a full "
                         "checkpoint reload and converge to parity 0.0)")
+    p.add_argument("--net", action="store_true",
+                   help="run the TCP transport drill matrix instead "
+                        "(proc_kill / net_partition / net_slow against real "
+                        "spawned replica processes: lease-expiry respawn + "
+                        "rejoin, stale-write refusal on heal, bounded typed "
+                        "timeouts; nonzero exit on any unrecovered fault)")
     args = p.parse_args(argv)
 
     if args.serve:
@@ -301,6 +343,8 @@ def main(argv=None) -> int:
         return _drift_matrix(args)
     if args.freshness:
         return _freshness_matrix(args)
+    if args.net:
+        return _net_matrix(args)
 
     from swiftsnails_tpu.resilience.drill import run_drill_matrix
 
